@@ -1,0 +1,32 @@
+"""Test harness: an 8-device fake CPU pod.
+
+Mirrors the reference's answer to "multi-node testing without a cluster"
+(docker demo network on localhost; SURVEY.md §4): stations are mesh slices,
+so N fake CPU devices give an N-slot pod in CI.
+
+The image's sitecustomize registers a TPU PJRT plugin (importing jax) at
+interpreter startup — before this conftest — so plain env vars are too late
+for platform selection. Setting XLA_FLAGS still works (the CPU backend
+initializes lazily) and `jax.config.update("jax_platforms")` re-selects the
+backend post-import.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {devs}"
+    return devs

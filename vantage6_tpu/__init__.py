@@ -1,0 +1,13 @@
+"""vantage6-tpu: a TPU-native federated analysis framework.
+
+Re-founds IKNL/vantage6's capabilities (privacy-preserving federated analysis:
+tasks, collaborations, stations, encrypted aggregation) on a single JAX device
+mesh: data stations are sub-meshes, "partial" tasks run per-station under
+shard_map, and "central" aggregation lowers to XLA collectives over ICI.
+"""
+
+__version__ = "0.1.0"
+
+from vantage6_tpu.common.enums import TaskStatus, RunStatus  # noqa: F401
+from vantage6_tpu.core.config import FederationConfig  # noqa: F401
+from vantage6_tpu.core.mesh import FederationMesh, Station  # noqa: F401

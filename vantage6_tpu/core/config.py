@@ -1,0 +1,210 @@
+"""Federation configuration: YAML configs + contexts.
+
+Parity: vantage6-common context/configuration_manager (SURVEY.md §2 item 22) —
+the reference locates YAML node/server configs in well-known dirs, validates
+them against a schema, and exposes them through ``NodeContext``/
+``ServerContext``. Here one *federation* YAML describes the whole simulated
+network (server-side entities + every station), because stations are mesh
+slices of one pod rather than daemons on separate machines.
+
+Example::
+
+    federation:
+      name: demo
+      encrypted: false
+      devices_per_station: 1
+    stations:
+      - name: station_a
+        organization: org_a
+        api_key: "..."           # optional; parity with node api_key auth
+        databases:
+          - label: default
+            type: csv
+            uri: data/a.csv
+        policies:
+          allowed_algorithms: ["*"]
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+
+class ConfigurationError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class DatabaseConfig:
+    """One data source at a station (reference: node config `databases:`)."""
+
+    label: str
+    type: str = "csv"  # csv | parquet | excel | sql | sparql | omop | array
+    uri: str = ""
+    options: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    _KNOWN_TYPES = {"csv", "parquet", "excel", "sql", "sparql", "omop", "array"}
+
+    def validate(self) -> None:
+        if not self.label:
+            raise ConfigurationError("database needs a label")
+        if self.type not in self._KNOWN_TYPES:
+            raise ConfigurationError(
+                f"unknown database type {self.type!r}; expected one of "
+                f"{sorted(self._KNOWN_TYPES)}"
+            )
+
+
+@dataclasses.dataclass
+class StationConfig:
+    """Config of one data station (reference: one node YAML)."""
+
+    name: str
+    organization: str = ""
+    api_key: str = ""
+    databases: list[DatabaseConfig] = dataclasses.field(default_factory=list)
+    policies: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ConfigurationError("station needs a name")
+        labels = [d.label for d in self.databases]
+        if len(labels) != len(set(labels)):
+            raise ConfigurationError(f"duplicate database labels in {self.name}")
+        for d in self.databases:
+            d.validate()
+
+    def database(self, label: str = "default") -> DatabaseConfig:
+        for d in self.databases:
+            if d.label == label:
+                return d
+        raise KeyError(f"station {self.name} has no database {label!r}")
+
+
+@dataclasses.dataclass
+class FederationConfig:
+    """The whole federation: global options + all stations."""
+
+    name: str = "federation"
+    encrypted: bool = False
+    devices_per_station: int = 1
+    stations: list[StationConfig] = dataclasses.field(default_factory=list)
+    server: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def n_stations(self) -> int:
+        return len(self.stations)
+
+    def validate(self) -> None:
+        if not self.stations:
+            raise ConfigurationError("federation needs at least one station")
+        names = [s.name for s in self.stations]
+        if len(names) != len(set(names)):
+            raise ConfigurationError("duplicate station names")
+        for s in self.stations:
+            s.validate()
+
+    # ---------------------------------------------------------------- yaml io
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "FederationConfig":
+        fed = raw.get("federation", {}) or {}
+        stations = []
+        for s in raw.get("stations", []) or []:
+            dbs = [
+                DatabaseConfig(
+                    label=d.get("label", "default"),
+                    type=d.get("type", "csv"),
+                    uri=_interp_env(str(d.get("uri", ""))),
+                    options=d.get("options", {}) or {},
+                )
+                for d in (s.get("databases", []) or [])
+            ]
+            stations.append(
+                StationConfig(
+                    name=s.get("name", ""),
+                    organization=s.get("organization", ""),
+                    api_key=s.get("api_key", ""),
+                    databases=dbs,
+                    policies=s.get("policies", {}) or {},
+                )
+            )
+        cfg = cls(
+            name=fed.get("name", "federation"),
+            encrypted=bool(fed.get("encrypted", False)),
+            devices_per_station=int(fed.get("devices_per_station", 1)),
+            stations=stations,
+            server=raw.get("server", {}) or {},
+        )
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def load(cls, path: str | Path) -> "FederationConfig":
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        if not isinstance(raw, dict):
+            raise ConfigurationError(f"{path}: not a mapping")
+        return cls.from_dict(raw)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "federation": {
+                "name": self.name,
+                "encrypted": self.encrypted,
+                "devices_per_station": self.devices_per_station,
+            },
+            "server": self.server,
+            "stations": [
+                {
+                    "name": s.name,
+                    "organization": s.organization,
+                    "api_key": s.api_key,
+                    "databases": [
+                        {
+                            "label": d.label,
+                            "type": d.type,
+                            "uri": d.uri,
+                            "options": d.options,
+                        }
+                        for d in s.databases
+                    ],
+                    "policies": s.policies,
+                }
+                for s in self.stations
+            ],
+        }
+
+    def save(self, path: str | Path) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+
+def _interp_env(value: str) -> str:
+    """`${VAR}` env interpolation in URIs (reference config does the same)."""
+    return os.path.expandvars(value)
+
+
+def default_config_dir() -> Path:
+    """Well-known per-user config dir (reference uses appdirs)."""
+    base = os.environ.get("XDG_CONFIG_HOME", os.path.expanduser("~/.config"))
+    p = Path(base) / "vantage6_tpu"
+    return p
+
+
+def demo_federation(n_stations: int = 2, name: str = "dev") -> FederationConfig:
+    """Generate a demo federation config (reference: `v6 dev create-demo-network`)."""
+    return FederationConfig(
+        name=name,
+        stations=[
+            StationConfig(
+                name=f"station_{i}",
+                organization=f"org_{i}",
+                databases=[DatabaseConfig(label="default", type="array")],
+            )
+            for i in range(n_stations)
+        ],
+    )

@@ -1,0 +1,164 @@
+"""Federation mesh: maps N data stations onto the available JAX devices.
+
+This is the TPU-native replacement for the reference's data plane
+(vantage6-node daemons + Docker containers + HTTPS transport; SURVEY.md §1/§3).
+Each *data station* owns a slice of a `jax.sharding.Mesh`; a federated round is
+one jitted SPMD program in which "partial" functions run per-station under
+`shard_map` and "central" aggregation lowers to XLA collectives over ICI.
+
+Design (scales 1 chip -> full pod with one code path):
+
+- All per-station state is *stacked* on a leading station axis: an array of
+  shape ``[S, ...]`` holds every station's shard.
+- The mesh has axes ``('station', 'device')``. The station mesh-axis size D is
+  the largest divisor of S that fits the available devices; each of the D mesh
+  slots simulates ``S/D`` stations via an inner ``vmap``. With D == S every
+  station owns real devices; with D == 1 the same program runs on a laptop.
+- ``fed_map(fn, ...)`` = ``shard_map(vmap(fn))`` over the station axis.
+- Aggregation is expressed at the jnp level on station-sharded arrays
+  (``jnp.sum(x, axis=0)``) so GSPMD inserts the all-reduce/reduce-scatter —
+  the idiomatic XLA path — with explicit-collective variants in
+  ``vantage6_tpu.fed`` where masking/secure-sum needs per-station RNG.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 top-level; older: experimental
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+STATION_AXIS = "station"
+DEVICE_AXIS = "device"
+
+
+@dataclasses.dataclass(frozen=True)
+class Station:
+    """One data station (reference: a vantage6 node at an organization).
+
+    In the reference a station is a daemon next to private data; here it is an
+    index into the station axis of the federation mesh plus metadata. The
+    privacy boundary is preserved *semantically* by the API (partials only see
+    their own shard; only aggregates cross stations), not by physical network
+    isolation — see docs/THREAT_MODEL.md for the honest mapping.
+    """
+
+    index: int
+    name: str
+    organization: str = ""
+    databases: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+class FederationMesh:
+    """Owns the device mesh and the station-axis execution primitives.
+
+    Parameters
+    ----------
+    n_stations:
+        Number of data stations S in the federation.
+    devices:
+        Flat list of JAX devices (default: ``jax.devices()``).
+    devices_per_station:
+        Devices forming each station's sub-mesh (tensor/model parallelism
+        *within* a station rides the ``device`` mesh axis).
+    """
+
+    def __init__(
+        self,
+        n_stations: int,
+        devices: Sequence[jax.Device] | None = None,
+        devices_per_station: int = 1,
+    ):
+        if n_stations < 1:
+            raise ValueError("n_stations must be >= 1")
+        devices = list(devices if devices is not None else jax.devices())
+        if devices_per_station < 1 or devices_per_station > len(devices):
+            raise ValueError("invalid devices_per_station")
+        self.n_stations = n_stations
+        self.devices_per_station = devices_per_station
+        usable = len(devices) // devices_per_station
+        # Station mesh-axis size: largest divisor of S fitting the hardware.
+        self.station_axis_size = _largest_divisor_leq(n_stations, usable)
+        self.stations_per_slot = n_stations // self.station_axis_size
+        n_used = self.station_axis_size * devices_per_station
+        dev_array = np.array(devices[:n_used]).reshape(
+            self.station_axis_size, devices_per_station
+        )
+        self.mesh = Mesh(dev_array, (STATION_AXIS, DEVICE_AXIS))
+
+    # ------------------------------------------------------------------ specs
+    def station_spec(self, *trailing: Any) -> P:
+        """PartitionSpec sharding the leading (station) axis."""
+        return P(STATION_AXIS, *trailing)
+
+    def station_sharding(self, *trailing: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, self.station_spec(*trailing))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_stacked(self, tree: Any) -> Any:
+        """Place a pytree of stacked ``[S, ...]`` arrays onto the mesh,
+        station axis sharded. Works for numpy or jax inputs."""
+        sh = self.station_sharding()
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), tree)
+
+    def replicate(self, tree: Any) -> Any:
+        sh = self.replicated_sharding()
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), tree)
+
+    # ------------------------------------------------------------- execution
+    def fed_map(
+        self,
+        fn: Callable[..., Any],
+        *stacked_args: Any,
+        replicated_args: tuple[Any, ...] = (),
+    ) -> Any:
+        """Run ``fn`` once per station; return stacked ``[S, ...]`` outputs.
+
+        ``stacked_args`` are pytrees whose leaves carry a leading station axis
+        of size S (sharded over the mesh's station axis). ``replicated_args``
+        are broadcast to every station (e.g. the global model). This is the
+        TPU-native analogue of the reference's "create one subtask per
+        organization" fan-out (SURVEY.md §3.1) — but it is a single SPMD
+        program, not N containers.
+        """
+        n_s = len(stacked_args)
+
+        def block_fn(*args):
+            s_args, r_args = args[:n_s], args[n_s:]
+            # Each mesh slot holds a [S/D, ...] block of stations; the inner
+            # vmap walks the stations within the block.
+            return jax.vmap(lambda *sa: fn(*sa, *r_args))(*s_args)
+
+        in_specs = tuple(self.station_spec() for _ in stacked_args) + tuple(
+            P() for _ in replicated_args
+        )
+        return shard_map(
+            block_fn,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=self.station_spec(),
+        )(*stacked_args, *replicated_args)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"FederationMesh(S={self.n_stations}, "
+            f"station_axis={self.station_axis_size}, "
+            f"per_slot={self.stations_per_slot}, "
+            f"dps={self.devices_per_station})"
+        )

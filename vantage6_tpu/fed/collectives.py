@@ -1,0 +1,186 @@
+"""Federated aggregation primitives over the station axis.
+
+These replace the reference's application-level aggregation loop
+(`client.task.create(partial...)` fan-out + `wait_for_results` polling + HTTPS
+result hops; SURVEY.md §3.2): each primitive consumes *stacked* per-station
+pytrees (leading axis S, sharded over the mesh's station axis) and reduces
+them on-device. Under `jit`, GSPMD lowers the reductions to XLA all-reduce /
+reduce-scatter over ICI — the collective IS the aggregation.
+
+All primitives take an optional participation ``mask`` ([S] bool/float): the
+SPMD answer to the reference's asynchronous reality (offline nodes,
+stragglers, partial participation). A dropped station contributes weight 0 —
+bit-accurate FedAvg-with-dropout without breaking the single-program model.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def _station_count(stacked: Pytree) -> int:
+    leaves = jax.tree.leaves(stacked)
+    if not leaves:
+        raise ValueError("empty pytree")
+    return leaves[0].shape[0]
+
+
+def _norm_weights(
+    n: int, weights: jax.Array | None, mask: jax.Array | None
+) -> jax.Array:
+    w = jnp.ones((n,), jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
+    if mask is not None:
+        w = w * jnp.asarray(mask, jnp.float32)
+    return w
+
+
+def _weighted_leaf_sum(x: jax.Array, w: jax.Array) -> jax.Array:
+    """sum_i w[i] * x[i] over the leading (station) axis."""
+    ww = w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return jnp.sum(x * ww, axis=0)
+
+
+def fed_sum(stacked: Pytree, mask: jax.Array | None = None) -> Pytree:
+    """Sum each leaf over the station axis. Parity: the `sum` half of
+    v6-average's central step."""
+    if mask is None:
+        return jax.tree.map(lambda x: jnp.sum(x, axis=0), stacked)
+    m = jnp.asarray(mask)
+    return jax.tree.map(lambda x: _weighted_leaf_sum(x, m), stacked)
+
+
+def fed_mean(
+    stacked: Pytree,
+    weights: jax.Array | None = None,
+    mask: jax.Array | None = None,
+) -> Pytree:
+    """Weighted mean over stations — the FedAvg aggregator.
+
+    ``weights`` is typically per-station example counts ([S]); ``mask`` drops
+    stations (failure injection / partial participation). Division is by the
+    *effective* total weight so dropped stations don't bias the mean.
+    """
+    n = _station_count(stacked)
+    w = _norm_weights(n, weights, mask)
+    total = jnp.sum(w)
+    # Guard the all-dropped edge: return zeros rather than NaN.
+    denom = jnp.where(total > 0, total, 1.0)
+    return jax.tree.map(
+        lambda x: _weighted_leaf_sum(x, w) / jnp.asarray(denom, x.dtype), stacked
+    )
+
+
+def fed_weighted_stats(
+    sums: Pytree, counts: jax.Array, mask: jax.Array | None = None
+) -> tuple[Pytree, jax.Array]:
+    """(global sums, global count) from per-station (sums, counts) — the exact
+    shape of the reference's federated-average contract: partials return
+    {sum, count}, central divides. Returns aggregated sums and total count."""
+    g_sums = fed_sum(sums, mask=mask)
+    g_count = fed_sum(counts, mask=mask)
+    return g_sums, g_count
+
+
+def fed_concat(stacked: Pytree) -> Pytree:
+    """Flatten the station axis into the data axis: [S, n, ...] -> [S*n, ...].
+
+    The on-device analogue of the central step "fetch all partial results and
+    concatenate" (e.g. global event-time grids for Kaplan-Meier). With ragged
+    true sizes, pair with per-station validity masks.
+    """
+    return jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), stacked)
+
+
+# --------------------------------------------------------------------------
+# Secure aggregation: additive masking with exact modular-int cancellation.
+# --------------------------------------------------------------------------
+#
+# The reference's crypto story is (a) hybrid RSA+AES end-to-end payload
+# encryption in core and (b) Paillier-style secure sums inside algorithm
+# repos (SURVEY.md §2.3). Homomorphic bigint is the wrong tool on an MXU; the
+# TPU-native fast path is pairwise additive masking (Bonawitz et al. style):
+# station i adds sum_{j>i} PRG(k_ij) - sum_{j<i} PRG(k_ji); masks cancel in
+# the all-reduce. Values are quantized to int32 and masked modulo 2^32 so
+# cancellation is EXACT (float masking would not cancel bit-wise).
+#
+# HONESTY NOTE (see docs/THREAT_MODEL.md): masks here derive from one `key`,
+# so the guarantee is scoped to observers WITHOUT that key (e.g. a log/trace
+# reader, or a party shown a single masked tensor). A real deployment where
+# the aggregator is untrusted needs per-pair Diffie-Hellman secrets so no
+# single party can strip masks; the collective structure is identical — only
+# key provisioning changes. Paillier itself stays host-side
+# (`vantage6_tpu.common.paillier`) for parity tests.
+
+
+def _pair_mask(key: jax.Array, i: jax.Array, j: jax.Array, shape) -> jax.Array:
+    """Deterministic pairwise mask PRG(k_ij) as int32, same for both parties."""
+    k = jax.random.fold_in(jax.random.fold_in(key, i), j)
+    return jax.random.randint(k, shape, jnp.iinfo(jnp.int32).min,
+                              jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+
+
+def mask_station_value(
+    key: jax.Array, station: jax.Array, n_stations: int, quantized: jax.Array
+) -> jax.Array:
+    """Add this station's pairwise masks (mod 2^32) to its quantized value."""
+
+    def body(s, acc):
+        m = _pair_mask(key, jnp.minimum(station, s), jnp.maximum(station, s),
+                       quantized.shape)
+        sign = jnp.where(s == station, 0, jnp.where(s > station, 1, -1))
+        return acc + sign.astype(jnp.int32) * m  # int32 wraps (mod 2^32)
+
+    return jax.lax.fori_loop(0, n_stations, body, quantized)
+
+
+def quantize(x: jax.Array, scale: float) -> jax.Array:
+    return jnp.round(x * scale).astype(jnp.int32)
+
+
+def dequantize(q: jax.Array, scale: float) -> jax.Array:
+    return q.astype(jnp.float32) / scale
+
+
+def secure_sum(
+    stacked: jax.Array,
+    key: jax.Array,
+    scale: float = 2.0**16,
+) -> jax.Array:
+    """Secure sum over the station axis via pairwise additive masking.
+
+    ``stacked``: [S, ...] float array. Each station's contribution is
+    quantized, masked with pairwise PRG masks (unstrippable by an observer who
+    does not hold ``key`` — see the honesty note above for the aggregator
+    threat model), then summed; masks cancel exactly in int32 modular
+    arithmetic. Returns the dequantized float sum. Max representable |sum| is
+    2^31/scale; pick ``scale`` to trade range vs precision.
+    """
+    s = stacked.shape[0]
+    q = jax.vmap(lambda i, x: mask_station_value(key, i, s, quantize(x, scale)))(
+        jnp.arange(s), stacked
+    )
+    return dequantize(jnp.sum(q, axis=0), scale)
+
+
+def secure_fed_mean(
+    stacked: Pytree,
+    weights: jax.Array,
+    key: jax.Array,
+    scale: float = 2.0**16,
+) -> Pytree:
+    """FedAvg aggregation where both weighted sums and total weight go through
+    the secure-sum path — the aggregator never sees an individual station's
+    update in the clear."""
+    total_w = secure_sum(jnp.asarray(weights, jnp.float32), key, scale)
+    denom = jnp.where(total_w > 0, total_w, 1.0)
+    leaves, treedef = jax.tree.flatten(stacked)
+    out = []
+    for idx, x in enumerate(leaves):
+        w = jnp.asarray(weights, x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
+        leaf_key = jax.random.fold_in(key, idx + 1)
+        out.append(secure_sum(x * w, leaf_key, scale) / denom)
+    return jax.tree.unflatten(treedef, out)
