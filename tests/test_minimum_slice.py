@@ -1,0 +1,134 @@
+"""The keystone test (SURVEY.md §7): v6-average parity end to end.
+
+2+ stations -> per-station partial {sum, count} -> central mean, through the
+reference-shaped MockAlgorithmClient API, in host mode (pandas) and device
+mode (arrays, one SPMD program + on-device aggregation).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from vantage6_tpu.algorithm import MockAlgorithmClient
+from vantage6_tpu.common.enums import TaskStatus
+from vantage6_tpu.workloads import average
+
+
+def make_client(n=2, rows=50, module=average):
+    rng = np.random.default_rng(0)
+    dfs, all_vals = [], []
+    for _ in range(n):
+        vals = rng.normal(size=rows)
+        all_vals.append(vals)
+        dfs.append([{"database": pd.DataFrame({"age": vals, "other": vals * 2})}])
+    return MockAlgorithmClient(datasets=dfs, module=module), np.concatenate(all_vals)
+
+
+def test_host_mode_average_matches_pooled():
+    client, pooled = make_client(n=2)
+    ids = [o["id"] for o in client.organization.list()]
+    assert ids == [0, 1]
+    task = client.task.create(
+        input_={"method": "central_average", "kwargs": {"column": "age"}},
+        organizations=[ids[0]],
+    )
+    assert task["status"] == TaskStatus.COMPLETED.value
+    (result,) = client.result.get(task["id"])
+    assert result["count"] == len(pooled)
+    np.testing.assert_allclose(result["average"], pooled.mean(), rtol=1e-6)
+
+
+def test_partial_only_task():
+    client, _ = make_client(n=3)
+    task = client.task.create(
+        input_={"method": "partial_average", "kwargs": {"column": "age"}},
+        organizations=[0, 2],
+    )
+    results = client.result.get(task["id"])
+    assert len(results) == 2 and all("sum" in r for r in results)
+    runs = client.run.from_task(task["id"])
+    assert [r["organization"] for r in runs] == ["org_0", "org_2"]
+
+
+def test_device_mode_average_matches_pooled():
+    rng = np.random.default_rng(1)
+    n, rows = 8, 40
+    data = [rng.normal(size=(rows, 3)).astype(np.float32) for _ in range(n)]
+    client = MockAlgorithmClient(
+        datasets=[[{"database": {"x": d}}] for d in data], module=average
+    )
+    task = client.task.create(
+        input_={"method": "central_average_device", "kwargs": {"column_index": 1}},
+        organizations=[0],
+    )
+    (result,) = client.result.get(task["id"])
+    pooled = np.concatenate([d[:, 1] for d in data])
+    np.testing.assert_allclose(result["average"], pooled.mean(), rtol=1e-4)
+    assert result["count"] == n * rows
+
+
+def test_device_mode_respects_organization_subset():
+    """Non-participating stations must not leak into device aggregation."""
+    rng = np.random.default_rng(2)
+    data = [rng.normal(size=(10, 2)).astype(np.float32) for _ in range(4)]
+    client = MockAlgorithmClient(
+        datasets=[[{"database": {"x": d}}] for d in data], module=average
+    )
+    task = client.task.create(
+        input_={
+            "method": "central_average_device",
+            "kwargs": {"column_index": 0, "organizations": [0, 2]},
+        },
+        organizations=[0],
+    )
+    (result,) = client.result.get(task["id"])
+    pooled_subset = np.concatenate([data[0][:, 0], data[2][:, 0]])
+    np.testing.assert_allclose(result["average"], pooled_subset.mean(), rtol=1e-4)
+    assert result["count"] == 20
+
+
+def test_anonymous_task_denied_by_user_allowlist():
+    """allowed_users must deny-by-default, including anonymous subtasks."""
+    import pandas as pd
+
+    from vantage6_tpu.runtime.federation import federation_from_datasets
+
+    fed = federation_from_datasets(
+        [pd.DataFrame({"x": [1.0]})], algorithms={"mock": average}
+    )
+    fed.config.stations[0].policies["allowed_users"] = ["alice"]
+    t = fed.create_task("mock", {"method": "partial_average",
+                                 "kwargs": {"column": "x"}})
+    assert t.runs[0].status == TaskStatus.NOT_ALLOWED
+
+
+def test_subtask_parentage():
+    client, _ = make_client(n=2)
+    task = client.task.create(
+        input_={"method": "central_average", "kwargs": {"column": "age"}},
+        organizations=[0],
+    )
+    fed = client.federation
+    subtasks = [t for t in fed.tasks.values() if t.parent_id == task["id"]]
+    assert len(subtasks) == 1
+    assert len(subtasks[0].runs) == 2  # fanned out to both orgs
+
+
+def test_crash_propagates_with_log():
+    client, _ = make_client(n=2)
+    task = client.task.create(
+        input_={"method": "partial_average", "kwargs": {"column": "missing"}},
+        organizations=[0, 1],
+    )
+    assert task["status"] == TaskStatus.CRASHED.value
+    with pytest.raises(RuntimeError, match="crashed"):
+        client.result.get(task["id"])
+    runs = client.run.from_task(task["id"])
+    assert "KeyError" in runs[0]["log"] or "missing" in runs[0]["log"]
+
+
+def test_unknown_method_fails():
+    client, _ = make_client(n=2)
+    task = client.task.create(
+        input_={"method": "nope"}, organizations=[0]
+    )
+    assert task["status"] == TaskStatus.FAILED.value

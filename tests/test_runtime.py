@@ -1,0 +1,142 @@
+"""Federation runtime: policies, offline stations, kill, drain, wrap ABI."""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from vantage6_tpu.algorithm import data
+from vantage6_tpu.common.enums import TaskStatus
+from vantage6_tpu.core.config import (
+    DatabaseConfig,
+    FederationConfig,
+    StationConfig,
+)
+from vantage6_tpu.runtime.federation import Federation, federation_from_datasets
+
+
+@data(1)
+def count_rows(df):
+    return {"n": len(df)}
+
+
+ALGO = {"count_rows": count_rows}
+
+
+def two_station_fed(policies0=None):
+    cfg = FederationConfig(
+        name="t",
+        stations=[
+            StationConfig(
+                name="a", organization="org_a", policies=policies0 or {},
+                databases=[DatabaseConfig(label="default", type="array")],
+            ),
+            StationConfig(
+                name="b", organization="org_b",
+                databases=[DatabaseConfig(label="default", type="array")],
+            ),
+        ],
+    )
+    fed = Federation(cfg, algorithms={"counter": ALGO})
+    fed.set_datasets(
+        "default", [pd.DataFrame({"x": [1, 2, 3]}), pd.DataFrame({"x": [4, 5]})]
+    )
+    return fed
+
+
+def test_policy_not_allowed():
+    fed = two_station_fed(policies0={"allowed_algorithms": ["trusted/*"]})
+    task = fed.create_task("counter", {"method": "count_rows"})
+    assert task.runs[0].status == TaskStatus.NOT_ALLOWED
+    assert task.runs[1].status == TaskStatus.COMPLETED  # other station ran
+
+
+def test_policy_glob_allows():
+    fed = two_station_fed(policies0={"allowed_algorithms": ["count*"]})
+    task = fed.create_task("counter", {"method": "count_rows"})
+    assert task.status == TaskStatus.COMPLETED
+
+
+def test_no_image():
+    fed = two_station_fed()
+    task = fed.create_task("ghost-image", {"method": "count_rows"})
+    assert task.status == TaskStatus.NO_IMAGE
+
+
+def test_allowed_users_policy():
+    fed = two_station_fed(policies0={"allowed_users": ["alice"]})
+    t1 = fed.create_task("counter", {"method": "count_rows"}, init_user="mallory")
+    assert t1.runs[0].status == TaskStatus.NOT_ALLOWED
+    t2 = fed.create_task("counter", {"method": "count_rows"}, init_user="alice")
+    assert t2.runs[0].status == TaskStatus.COMPLETED
+
+
+def test_offline_station_queues_then_drains():
+    fed = two_station_fed()
+    fed.set_station_online(1, False)
+    task = fed.create_task("counter", {"method": "count_rows"})
+    assert task.runs[1].status == TaskStatus.PENDING
+    with pytest.raises(RuntimeError, match="offline"):
+        fed.wait_for_results(task.id)
+    # reconnect -> node syncs its missed queue (reference:
+    # sync_task_queue_with_server) and the task completes
+    fed.set_station_online(1, True)
+    assert task.status == TaskStatus.COMPLETED
+    assert fed.wait_for_results(task.id)[1] == {"n": 2}
+
+
+def test_kill_task():
+    fed = two_station_fed()
+    fed.set_station_online(0, False)
+    task = fed.create_task("counter", {"method": "count_rows"})
+    fed.kill_task(task.id)
+    assert task.runs[0].status == TaskStatus.KILLED
+    # completed runs stay completed
+    assert task.runs[1].status == TaskStatus.COMPLETED
+
+
+def test_wrap_algorithm_env_abi(tmp_path):
+    """Container-ABI parity: method dispatch via INPUT_FILE/OUTPUT_FILE env."""
+    from vantage6_tpu.algorithm.wrap import wrap_algorithm
+    from vantage6_tpu.common.serialization import deserialize, serialize
+
+    csv = tmp_path / "d.csv"
+    pd.DataFrame({"x": [1.0, 2.0, 3.0]}).to_csv(csv, index=False)
+    (tmp_path / "input.json").write_bytes(
+        serialize({"method": "count_rows", "kwargs": {}})
+    )
+    env = {
+        "INPUT_FILE": str(tmp_path / "input.json"),
+        "OUTPUT_FILE": str(tmp_path / "output.json"),
+        "USER_REQUESTED_DATABASE_LABELS": "default",
+        "DATABASE_DEFAULT_URI": str(csv),
+        "DATABASE_DEFAULT_TYPE": "csv",
+    }
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        wrap_algorithm(_mod())
+        out = deserialize((tmp_path / "output.json").read_bytes())
+        assert out == {"n": 3}
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _mod():
+    import types
+
+    m = types.ModuleType("fake_algo")
+    m.count_rows = count_rows
+    return m
+
+
+def test_federation_from_datasets_array_stacking():
+    data_ = [np.ones((4, 2), np.float32) * i for i in range(4)]
+    fed = federation_from_datasets(data_, algorithms={})
+    stacked = fed.stacked_data()
+    assert stacked.shape == (4, 4, 2)
+    assert float(np.asarray(stacked[2]).mean()) == 2.0

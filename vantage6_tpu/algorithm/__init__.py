@@ -1,0 +1,11 @@
+"""Algorithm-facing API (parity with vantage6-algorithm-tools)."""
+
+from vantage6_tpu.algorithm.client import AlgorithmClient  # noqa: F401
+from vantage6_tpu.algorithm.decorators import (  # noqa: F401
+    algorithm_client,
+    data,
+    device_step,
+    metadata,
+)
+from vantage6_tpu.algorithm.mock_client import MockAlgorithmClient  # noqa: F401
+from vantage6_tpu.algorithm.wrap import wrap_algorithm  # noqa: F401

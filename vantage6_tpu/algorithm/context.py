@@ -1,0 +1,62 @@
+"""Per-run execution environment for algorithm functions.
+
+The reference passes an algorithm its world through the container boundary:
+env vars (INPUT_FILE, TOKEN_FILE, OUTPUT_FILE, DATABASE_URI...), mounted data
+files, and a proxy URL (SURVEY.md §2 item 18). Here a run's world is an
+`AlgorithmEnvironment` bound to a context variable while the function
+executes — the decorators read from it. The env-file ABI is still supported
+for container-parity via `vantage6_tpu.algorithm.wrap`.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Any, Iterator
+
+
+@dataclasses.dataclass
+class RunMetadata:
+    """Injected by @metadata (reference: algorithm tools' RunMetaData)."""
+
+    task_id: int | None = None
+    run_id: int | None = None
+    node_id: int | None = None
+    organization: str = ""
+    collaboration: str = ""
+    temporary_directory: str | None = None
+
+
+@dataclasses.dataclass
+class AlgorithmEnvironment:
+    """Everything an algorithm function may have injected."""
+
+    dataframes: list[Any] = dataclasses.field(default_factory=list)
+    client: Any = None  # AlgorithmClient
+    metadata: RunMetadata = dataclasses.field(default_factory=RunMetadata)
+
+
+_current: contextvars.ContextVar[AlgorithmEnvironment | None] = (
+    contextvars.ContextVar("v6t_algorithm_env", default=None)
+)
+
+
+def current_environment() -> AlgorithmEnvironment:
+    env = _current.get()
+    if env is None:
+        raise RuntimeError(
+            "no algorithm environment active — algorithm functions decorated "
+            "with @data/@algorithm_client/@metadata must be invoked through a "
+            "Federation / MockAlgorithmClient / wrap_algorithm, not called "
+            "directly (pass data explicitly to call them standalone)"
+        )
+    return env
+
+
+@contextlib.contextmanager
+def algorithm_environment(env: AlgorithmEnvironment) -> Iterator[None]:
+    token = _current.set(env)
+    try:
+        yield
+    finally:
+        _current.reset(token)

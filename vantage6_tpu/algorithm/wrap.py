@@ -1,0 +1,126 @@
+"""wrap_algorithm — the container-ABI entrypoint, kept for parity.
+
+Parity: vantage6-algorithm-tools `wrap.py` (SURVEY.md §2 item 18). In the
+reference every algorithm image's entrypoint calls ``wrap_algorithm()``,
+which reads env vars (INPUT_FILE, OUTPUT_FILE, TOKEN_FILE, database URIs),
+deserializes ``{"method", "args", "kwargs"}``, dispatches the named function
+from the algorithm module, and writes the serialized result to OUTPUT_FILE.
+
+The env-var names are reconstructed ([M] in SURVEY.md — empty reference
+mount): ``INPUT_FILE``, ``OUTPUT_FILE``, ``TOKEN_FILE``,
+``USER_REQUESTED_DATABASE_LABELS`` (comma-separated) and per label
+``DATABASE_<LABEL>_URI`` / ``DATABASE_<LABEL>_TYPE``.
+
+On-pod execution does NOT go through this file — the Federation binds an
+`AlgorithmEnvironment` directly (no serialization boundary in the hot loop).
+This entrypoint exists so an algorithm written for this framework can still
+be shipped as a standalone container against a remote control plane, and so
+the ABI is testable. A client is injected only when ``V6T_SERVER_URL`` names
+a control-plane REST server (see vantage6_tpu.server); otherwise
+client-needing functions fail with a clear error.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from types import ModuleType
+from typing import Any
+
+from vantage6_tpu.algorithm.context import (
+    AlgorithmEnvironment,
+    RunMetadata,
+    algorithm_environment,
+)
+from vantage6_tpu.algorithm.data_loading import load_data
+from vantage6_tpu.common.serialization import deserialize, serialize
+from vantage6_tpu.core.config import DatabaseConfig
+
+
+def wrap_algorithm(module: ModuleType | str | None = None) -> None:
+    """Run one algorithm method per the env-file ABI and exit.
+
+    ``module`` defaults to the main module (the reference resolves the
+    algorithm package the same way).
+    """
+    if module is None:
+        module = sys.modules["__main__"]
+    elif isinstance(module, str):
+        import importlib
+
+        module = importlib.import_module(module)
+
+    input_path = _require_env("INPUT_FILE")
+    output_path = _require_env("OUTPUT_FILE")
+    with open(input_path, "rb") as f:
+        payload = deserialize(f.read())
+    method = payload.get("method")
+    if not method:
+        raise ValueError("input payload needs a 'method'")
+    fn = getattr(module, method, None)
+    if fn is None:
+        raise AttributeError(
+            f"method {method!r} not found in {module.__name__}"
+        )
+
+    env = AlgorithmEnvironment(
+        dataframes=_load_env_databases(),
+        client=_maybe_rest_client(),
+        metadata=RunMetadata(
+            task_id=_int_env("TASK_ID"),
+            run_id=_int_env("RUN_ID"),
+            node_id=_int_env("NODE_ID"),
+            organization=os.environ.get("ORGANIZATION_NAME", ""),
+            collaboration=os.environ.get("COLLABORATION_NAME", ""),
+            temporary_directory=os.environ.get("TEMPORARY_FOLDER"),
+        ),
+    )
+    args = payload.get("args", []) or []
+    kwargs = payload.get("kwargs", {}) or {}
+    with algorithm_environment(env):
+        result = fn(*args, **kwargs)
+    with open(output_path, "wb") as f:
+        f.write(serialize(result))
+
+
+def _require_env(name: str) -> str:
+    v = os.environ.get(name)
+    if not v:
+        raise EnvironmentError(f"required env var {name} not set")
+    return v
+
+
+def _int_env(name: str) -> int | None:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def _load_env_databases() -> list[Any]:
+    labels = [
+        l.strip()
+        for l in os.environ.get("USER_REQUESTED_DATABASE_LABELS", "").split(",")
+        if l.strip()
+    ]
+    frames = []
+    for label in labels:
+        key = label.upper()
+        uri = os.environ.get(f"DATABASE_{key}_URI", "")
+        typ = os.environ.get(f"DATABASE_{key}_TYPE", "csv")
+        frames.append(load_data(DatabaseConfig(label=label, type=typ, uri=uri)))
+    return frames
+
+
+def _maybe_rest_client() -> Any:
+    url = os.environ.get("V6T_SERVER_URL")
+    if not url:
+        return None
+    try:
+        from vantage6_tpu.client.rest import RestAlgorithmClient
+    except ImportError as e:
+        raise NotImplementedError(
+            "V6T_SERVER_URL is set but this build has no REST control-plane "
+            "client yet (vantage6_tpu.client.rest); run on-pod via the "
+            "Federation runtime instead"
+        ) from e
+    return RestAlgorithmClient(
+        url, token_file=os.environ.get("TOKEN_FILE", "")
+    )

@@ -1,0 +1,70 @@
+"""Task payload (de)serialization.
+
+Parity: the reference serializes task input/results as JSON written to the
+container's INPUT_FILE/OUTPUT_FILE (SURVEY.md §2 item 18). JSON stays the
+interchange default; numpy/jax arrays and pandas objects get a tagged
+encoding so federated payloads (model weights, statistics tables) round-trip
+without pickle (the reference moved away from pickle for the same
+security reason).
+"""
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Any
+
+import numpy as np
+
+
+def _encode(obj: Any) -> Any:
+    import jax
+
+    if isinstance(obj, (np.ndarray, np.generic)) or (
+        hasattr(jax, "Array") and isinstance(obj, jax.Array)
+    ):
+        arr = np.asarray(obj)
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        return {
+            "__v6t__": "ndarray",
+            "data": base64.b64encode(buf.getvalue()).decode("ascii"),
+        }
+    try:
+        import pandas as pd
+
+        if isinstance(obj, pd.DataFrame):
+            return {"__v6t__": "dataframe", "data": obj.to_json(orient="split")}
+        if isinstance(obj, pd.Series):
+            return {"__v6t__": "series", "data": obj.to_json(orient="split")}
+    except ImportError:  # pragma: no cover
+        pass
+    raise TypeError(f"not JSON-serializable: {type(obj)}")
+
+
+def _decode(d: dict[str, Any]) -> Any:
+    tag = d.get("__v6t__")
+    if tag is None:
+        return d
+    if tag == "ndarray":
+        buf = io.BytesIO(base64.b64decode(d["data"]))
+        return np.load(buf, allow_pickle=False)
+    if tag == "dataframe":
+        import pandas as pd
+
+        return pd.read_json(io.StringIO(d["data"]), orient="split")
+    if tag == "series":
+        import pandas as pd
+
+        return pd.read_json(io.StringIO(d["data"]), orient="split", typ="series")
+    raise ValueError(f"unknown payload tag {tag!r}")
+
+
+def serialize(payload: Any) -> bytes:
+    return json.dumps(payload, default=_encode).encode("utf-8")
+
+
+def deserialize(blob: bytes | str) -> Any:
+    if isinstance(blob, bytes):
+        blob = blob.decode("utf-8")
+    return json.loads(blob, object_hook=_decode)

@@ -1,0 +1,407 @@
+"""The Federation orchestrator: server + nodes, re-founded on one mesh.
+
+Parity mapping (SURVEY.md §3):
+
+- reference server task queue + SocketIO fan-out  -> `create_task` dispatch
+- node daemon picking up a task                   -> per-station execution
+- DockerManager policy check / image check        -> `_check_policies`
+- algorithm container running `wrap_algorithm`    -> `AlgorithmEnvironment`
+  bound around the registered function
+- node harvesting results + PATCH status          -> Run.finish/crash
+- `wait_for_results` polling over HTTPS           -> immediate fetch (host
+  mode) or an on-device stacked result (device mode)
+
+Two execution modes per partial function:
+
+- **host mode** (default): arbitrary Python (pandas/sklearn) runs per-station
+  in-process — full reference compatibility for existing algorithm logic.
+- **device mode** (`@device_step`): the partial is jax-traceable; all
+  stations execute as ONE SPMD program via `FederationMesh.fed_map`, results
+  stay on device, and aggregation lowers to XLA collectives. This is the TPU
+  fast path that replaces container lifecycle + HTTPS polling.
+"""
+from __future__ import annotations
+
+import fnmatch
+import traceback
+from types import ModuleType
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from vantage6_tpu.algorithm.context import (
+    AlgorithmEnvironment,
+    RunMetadata,
+    algorithm_environment,
+)
+from vantage6_tpu.algorithm.data_loading import load_data
+from vantage6_tpu.common.enums import TaskStatus
+from vantage6_tpu.core.config import DatabaseConfig, FederationConfig
+from vantage6_tpu.core.mesh import FederationMesh, Station
+from vantage6_tpu.runtime.task import Run, Task, new_run, new_task
+
+
+class Federation:
+    """One collaboration's stations + task engine.
+
+    ``algorithms`` maps an image name (the reference's Docker-image role) to a
+    module or ``{name: fn}`` dict of algorithm functions.
+    """
+
+    def __init__(
+        self,
+        config: FederationConfig,
+        devices: Any = None,
+        algorithms: dict[str, ModuleType | dict[str, Callable]] | None = None,
+    ):
+        config.validate()
+        self.config = config
+        self.mesh = FederationMesh(
+            config.n_stations,
+            devices=devices,
+            devices_per_station=config.devices_per_station,
+        )
+        self.stations = [
+            Station(index=i, name=s.name, organization=s.organization or s.name)
+            for i, s in enumerate(config.stations)
+        ]
+        self._online = [True] * config.n_stations
+        # station data: per-station {label: dataset}; device-mode stacked
+        # arrays cached per label.
+        self._data: list[dict[str, Any]] = [{} for _ in self.stations]
+        self._stacked_cache: dict[str, Any] = {}
+        self._algorithms: dict[str, dict[str, Callable]] = {}
+        for image, mod in (algorithms or {}).items():
+            self.register_algorithm(image, mod)
+        self.tasks: dict[int, Task] = {}
+
+    # ------------------------------------------------------------------ data
+    def load_all_data(self) -> None:
+        """Read every station's configured databases (csv/parquet/sql/...)."""
+        for i, scfg in enumerate(self.config.stations):
+            for db in scfg.databases:
+                self._data[i][db.label] = load_data(db)
+        self._stacked_cache.clear()
+
+    def set_datasets(self, label: str, datasets: list[Any]) -> None:
+        """Programmatically supply one dataset per station (mock-style)."""
+        if len(datasets) != self.n_stations:
+            raise ValueError(
+                f"need {self.n_stations} datasets, got {len(datasets)}"
+            )
+        for i, d in enumerate(datasets):
+            self._data[i][label] = d
+        self._stacked_cache.pop(label, None)
+
+    def station_data(self, station: int, label: str = "default") -> Any:
+        if label not in self._data[station]:
+            raise KeyError(
+                f"station {self.stations[station].name} has no data {label!r} "
+                "(call load_all_data() or set_datasets())"
+            )
+        return self._data[station][label]
+
+    def stacked_data(self, label: str = "default") -> Any:
+        """Stack all stations' array data [S, ...] and shard over the mesh.
+
+        Device-mode partials consume this; requires homogeneous shapes (pad +
+        mask ragged data upstream — see fed.collectives participation masks).
+        """
+        if label not in self._stacked_cache:
+            per = [self.station_data(i, label) for i in range(self.n_stations)]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+            self._stacked_cache[label] = self.mesh.shard_stacked(stacked)
+        return self._stacked_cache[label]
+
+    # ------------------------------------------------------------ algorithms
+    def register_algorithm(
+        self, image: str, module: ModuleType | dict[str, Callable]
+    ) -> None:
+        if isinstance(module, dict):
+            fns = dict(module)
+        else:
+            # Only functions DEFINED in the module are dispatchable — imported
+            # helpers (decorators, jnp, ...) must not become callable methods.
+            fns = {
+                name: fn
+                for name, fn in vars(module).items()
+                if callable(fn)
+                and not name.startswith("_")
+                and getattr(fn, "__module__", None) == module.__name__
+            }
+        self._algorithms[image] = fns
+
+    def resolve_function(self, image: str, method: str) -> Callable | None:
+        return self._algorithms.get(image, {}).get(method)
+
+    # ------------------------------------------------------------- stations
+    @property
+    def n_stations(self) -> int:
+        return len(self.stations)
+
+    def organization_ids(self) -> list[int]:
+        return list(range(self.n_stations))
+
+    def organizations(self) -> list[dict[str, Any]]:
+        return [
+            {"id": s.index, "name": s.organization}
+            for s in self.stations
+        ]
+
+    def set_station_online(self, station: int, online: bool) -> None:
+        """Failure injection: an offline station's runs stay PENDING (the
+        reference queues tasks for offline nodes the same way)."""
+        was = self._online[station]
+        self._online[station] = online
+        if online and not was:
+            self._drain_pending(station)
+
+    def participation_mask(self) -> jnp.ndarray:
+        return jnp.asarray(self._online, jnp.float32)
+
+    # ----------------------------------------------------------------- tasks
+    def create_task(
+        self,
+        image: str,
+        input_: dict[str, Any],
+        organizations: list[int] | None = None,
+        name: str = "task",
+        databases: list[dict[str, Any]] | None = None,
+        parent: Task | None = None,
+        init_user: str = "",
+    ) -> Task:
+        """Create + dispatch a task (reference: POST /api/task + fan-out).
+
+        ``input_`` is the reference's wire shape: ``{"method", "args",
+        "kwargs"}``. Execution is synchronous (statuses still transition
+        PENDING→ACTIVE→COMPLETED so observing code ports); offline stations
+        keep their runs PENDING until `set_station_online` drains them.
+        """
+        method = input_.get("method")
+        if not method:
+            raise ValueError('input_ needs a "method"')
+        if parent and not init_user:
+            # Subtasks act on behalf of the user who created the parent, so
+            # allowed_users policies apply to the whole task tree.
+            init_user = parent.init_user
+        orgs = (
+            list(organizations)
+            if organizations is not None
+            else self.organization_ids()
+        )
+        for o in orgs:
+            if not 0 <= o < self.n_stations:
+                raise ValueError(f"unknown organization id {o}")
+        task = new_task(
+            name=name,
+            method=method,
+            image=image,
+            organizations=[self.stations[o].organization for o in orgs],
+            input_=input_,
+            databases=databases or [{"label": "default"}],
+            parent_id=parent.id if parent else None,
+            collaboration=self.config.name,
+            init_user=init_user,
+        )
+        task.runs = [
+            new_run(
+                task_id=task.id,
+                organization=self.stations[o].organization,
+                station_index=o,
+            )
+            for o in orgs
+        ]
+        self.tasks[task.id] = task
+        self._dispatch(task)
+        return task
+
+    def get_task(self, task_id: int) -> Task:
+        return self.tasks[task_id]
+
+    def kill_task(self, task_id: int) -> None:
+        """Parity: the server's `kill` SocketIO event."""
+        for r in self.tasks[task_id].runs:
+            if not r.status.is_finished:
+                r.status = TaskStatus.KILLED
+
+    def wait_for_results(self, task_id: int) -> list[Any]:
+        """Fetch results of finished runs (reference: poll /api/result).
+
+        Raises if the task failed; PENDING runs on offline stations raise a
+        RuntimeError naming the stations still owed a result.
+        """
+        task = self.tasks[task_id]
+        bad = [r for r in task.runs if r.status.has_failed]
+        if bad:
+            r = bad[0]
+            raise RuntimeError(
+                f"task {task_id} {r.status.value} at {r.organization}: {r.log}"
+            )
+        waiting = [r.organization for r in task.runs if not r.status.is_finished]
+        if waiting:
+            raise RuntimeError(
+                f"task {task_id} still waiting on offline station(s) "
+                f"{waiting} — bring them online or re-create the task "
+                "excluding them"
+            )
+        return task.results()
+
+    # -------------------------------------------------------------- dispatch
+    def _check_policies(self, task: Task, station: int) -> TaskStatus | None:
+        """DockerManager-equivalent policy gate (SURVEY.md §2 item 11)."""
+        if task.image not in self._algorithms:
+            return TaskStatus.NO_IMAGE
+        pol = self.config.stations[station].policies
+        allowed = pol.get("allowed_algorithms")
+        if allowed and not any(fnmatch.fnmatch(task.image, a) for a in allowed):
+            return TaskStatus.NOT_ALLOWED
+        users = pol.get("allowed_users")
+        # An anonymous task does NOT bypass a user allow-list: deny-by-default.
+        if users and task.init_user not in users:
+            return TaskStatus.NOT_ALLOWED
+        return None
+
+    def _dispatch(self, task: Task) -> None:
+        fn = self.resolve_function(task.image, task.method)
+        # Policy/image gates run per station first (a NO_IMAGE station fails
+        # its run; others may still compute — reference behaves the same).
+        runnable: list[Run] = []
+        for run in task.runs:
+            verdict = self._check_policies(task, run.station_index)
+            if verdict is not None:
+                run.status = verdict
+                run.log = f"policy gate: {verdict.value}"
+            elif fn is None:
+                run.status = TaskStatus.FAILED
+                run.log = (
+                    f"method {task.method!r} not found in image {task.image!r}"
+                )
+            elif not self._online[run.station_index]:
+                run.status = TaskStatus.PENDING  # queued until reconnect
+            else:
+                runnable.append(run)
+        if not runnable or fn is None:
+            return
+        if getattr(fn, "__v6t_device_step__", False):
+            self._run_device_step(task, fn, runnable)
+        else:
+            for run in runnable:
+                self._run_host(task, fn, run)
+
+    # ------------------------------------------------------------- host mode
+    def _run_host(self, task: Task, fn: Callable, run: Run) -> None:
+        from vantage6_tpu.algorithm.client import AlgorithmClient
+
+        run.start()
+        frames = [
+            self.station_data(run.station_index, d.get("label", "default"))
+            for d in task.databases
+        ]
+        env = AlgorithmEnvironment(
+            dataframes=frames,
+            client=AlgorithmClient(self, task=task, station=run.station_index),
+            metadata=RunMetadata(
+                task_id=task.id,
+                run_id=run.id,
+                node_id=run.station_index,
+                organization=run.organization,
+                collaboration=self.config.name,
+            ),
+        )
+        args = task.input_.get("args", []) or []
+        kwargs = task.input_.get("kwargs", {}) or {}
+        try:
+            with algorithm_environment(env):
+                run.finish(fn(*args, **kwargs))
+        except Exception:
+            run.crash(traceback.format_exc(limit=8))
+
+    # ----------------------------------------------------------- device mode
+    def _run_device_step(
+        self, task: Task, fn: Callable, runnable: list[Run]
+    ) -> None:
+        """All stations' partials as ONE SPMD program.
+
+        The function receives this station's array data (label of the task's
+        first database) plus input_ args/kwargs; `fed_map` runs it across the
+        FULL station axis (SPMD is a barrier — non-participants compute too,
+        but their output is excluded), and participating stations' slices
+        land in their Run records as device arrays. The full stacked output
+        plus a [S] participation mask are kept on the task so central code
+        aggregates on device with the mask (fed collectives all accept one).
+        """
+        label = task.databases[0].get("label", "default")
+        args = tuple(task.input_.get("args", []) or [])
+        kwargs = dict(task.input_.get("kwargs", {}) or {})
+        for run in runnable:
+            run.start()
+        try:
+            stacked = self.stacked_data(label)
+            out = self.mesh.fed_map(
+                lambda d: fn(d, *args, **kwargs), stacked
+            )
+        except Exception:
+            tb = traceback.format_exc(limit=8)
+            for run in runnable:
+                run.crash(tb)
+            return
+        task.stacked_result = out
+        mask = [0.0] * self.n_stations
+        for run in runnable:
+            mask[run.station_index] = 1.0
+        new_mask = jnp.asarray(mask, jnp.float32)
+        task.participation = (
+            new_mask
+            if task.participation is None
+            # A drain after reconnect adds to the already-completed set.
+            else jnp.maximum(task.participation, new_mask)
+        )
+        for run in runnable:
+            i = run.station_index
+            run.finish(jax.tree.map(lambda x: x[i], out))
+
+    # ------------------------------------------------------ elastic recovery
+    def _drain_pending(self, station: int) -> None:
+        """Reference parity: a reconnecting node syncs its missed task queue
+        (`sync_task_queue_with_server`) and executes what it owes."""
+        for task in self.tasks.values():
+            fn = self.resolve_function(task.image, task.method)
+            if fn is None:
+                continue
+            for run in task.runs:
+                if (
+                    run.station_index == station
+                    and run.status == TaskStatus.PENDING
+                ):
+                    if getattr(fn, "__v6t_device_step__", False):
+                        self._run_device_step(task, fn, [run])
+                    else:
+                        self._run_host(task, fn, run)
+
+
+def federation_from_datasets(
+    datasets: list[Any],
+    algorithms: dict[str, Any],
+    label: str = "default",
+    devices: Any = None,
+    name: str = "mock",
+) -> Federation:
+    """Build a ready Federation from in-memory per-station datasets —
+    the MockAlgorithmClient construction path."""
+    from vantage6_tpu.core.config import StationConfig
+
+    cfg = FederationConfig(
+        name=name,
+        stations=[
+            StationConfig(
+                name=f"station_{i}",
+                organization=f"org_{i}",
+                databases=[DatabaseConfig(label=label, type="array")],
+            )
+            for i in range(len(datasets))
+        ],
+    )
+    fed = Federation(cfg, devices=devices, algorithms=algorithms)
+    fed.set_datasets(label, datasets)
+    return fed

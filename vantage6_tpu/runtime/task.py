@@ -1,0 +1,131 @@
+"""Task and Run records — the control-plane vocabulary of the reference.
+
+Parity: vantage6-server ORM `Task` / `Run` entities (SURVEY.md §2 item 2) and
+the status lifecycle of §2 item 23. A *task* is one request ("run `method` of
+`image` on these organizations"); it fans out into one *run* per target
+organization. The reference persists these in SQLAlchemy and moves them via
+REST+SocketIO; here they are in-memory records moved by the orchestrator, with
+identical states so client code observing them ports unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any
+
+from vantage6_tpu.common.enums import TaskStatus
+
+_task_ids = itertools.count(1)
+_run_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class Run:
+    """One organization's execution of a task (reference: `Run`, né `Result`)."""
+
+    id: int
+    task_id: int
+    organization: str
+    station_index: int
+    status: TaskStatus = TaskStatus.PENDING
+    result: Any = None
+    log: str = ""
+    assigned_at: float = dataclasses.field(default_factory=time.time)
+    started_at: float | None = None
+    finished_at: float | None = None
+
+    def start(self) -> None:
+        self.status = TaskStatus.ACTIVE
+        self.started_at = time.time()
+
+    def finish(self, result: Any) -> None:
+        self.result = result
+        self.status = TaskStatus.COMPLETED
+        self.finished_at = time.time()
+
+    def crash(self, log: str) -> None:
+        self.log = log
+        self.status = TaskStatus.CRASHED
+        self.finished_at = time.time()
+
+
+@dataclasses.dataclass
+class Task:
+    """A federated task: method + input fanned out to organizations.
+
+    `image` survives as the algorithm identifier (the reference addresses
+    algorithms by Docker image name; here it names a registered algorithm
+    module — same role, no container).
+    """
+
+    id: int
+    name: str
+    method: str
+    image: str
+    organizations: list[str]
+    input_: dict[str, Any] = dataclasses.field(default_factory=dict)
+    databases: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    parent_id: int | None = None  # subtasks created by a central fn
+    init_org: str = ""
+    init_user: str = ""
+    collaboration: str = ""
+    runs: list[Run] = dataclasses.field(default_factory=list)
+    created_at: float = dataclasses.field(default_factory=time.time)
+    # Device-mode only: the stacked [S, ...] on-device result pytree (full
+    # station axis) plus the [S] participation mask — 1.0 where the station
+    # was targeted by this task AND completed. Central aggregation consumes
+    # both without a host round-trip.
+    stacked_result: Any = None
+    participation: Any = None
+
+    @property
+    def status(self) -> TaskStatus:
+        """Aggregate status over runs (reference computes the same rollup)."""
+        if not self.runs:
+            return TaskStatus.PENDING
+        statuses = {r.status for r in self.runs}
+        for bad in (TaskStatus.KILLED, TaskStatus.NOT_ALLOWED, TaskStatus.NO_IMAGE,
+                    TaskStatus.CRASHED, TaskStatus.FAILED):
+            if bad in statuses:
+                return bad
+        if statuses == {TaskStatus.COMPLETED}:
+            return TaskStatus.COMPLETED
+        if TaskStatus.ACTIVE in statuses or TaskStatus.INITIALIZING in statuses:
+            return TaskStatus.ACTIVE
+        return TaskStatus.PENDING
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status.is_finished
+
+    def results(self) -> list[Any]:
+        return [r.result for r in self.runs]
+
+    def to_dict(self) -> dict[str, Any]:
+        """Wire shape compatible with the reference's /api/task JSON."""
+        return {
+            "id": self.id,
+            "name": self.name,
+            "image": self.image,
+            "method": self.method,
+            "status": self.status.value,
+            "parent": {"id": self.parent_id} if self.parent_id else None,
+            "collaboration": {"name": self.collaboration},
+            "runs": [
+                {
+                    "id": r.id,
+                    "organization": r.organization,
+                    "status": r.status.value,
+                }
+                for r in self.runs
+            ],
+        }
+
+
+def new_task(**kw: Any) -> Task:
+    return Task(id=next(_task_ids), **kw)
+
+
+def new_run(**kw: Any) -> Run:
+    return Run(id=next(_run_ids), **kw)
