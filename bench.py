@@ -1,0 +1,132 @@
+"""Benchmark: federated rounds/sec, 32-station FedAvg CNN (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- TPU path: the FedAvg engine — all 32 stations' local training + weighted
+  aggregation as one jitted SPMD program, multi-round via lax.scan.
+- Baseline: the reference's execution shape (SURVEY.md §3.2) emulated
+  *generously* on CPU — sequential per-station local training through the
+  host-mode task engine with JSON payload (de)serialization per hop, but NO
+  docker container lifecycle, NO HTTPS, NO polling intervals. The reference's
+  real per-round cost is dominated by exactly those omitted parts, so the
+  reported speedup is a conservative lower bound.
+
+Identical math both paths (same model/hyperparams/station count).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_STATIONS = 32
+N_PER_STATION = 256
+LOCAL_STEPS = 10
+BATCH = 32
+LR = 0.05
+TPU_ROUNDS = 20
+BASELINE_ROUNDS = 2
+
+
+def tpu_rounds_per_sec() -> float:
+    from vantage6_tpu.core.mesh import FederationMesh
+    from vantage6_tpu.workloads import fedavg_mnist as W
+
+    mesh = FederationMesh(N_STATIONS)
+    engine = W.make_engine(
+        mesh, local_steps=LOCAL_STEPS, batch_size=BATCH, local_lr=LR
+    )
+    sx, sy, counts = W.make_federated_data(
+        N_STATIONS, n_per_station=N_PER_STATION, mesh=mesh
+    )
+    key = jax.random.key(0)
+    params = W.init_params(jax.random.fold_in(key, 1))
+    # warmup/compile
+    p, _, _ = engine.run_rounds(params, sx, sy, counts, key, 2)
+    jax.block_until_ready(p)
+    t0 = time.perf_counter()
+    p, _, losses = engine.run_rounds(params, sx, sy, counts, key, TPU_ROUNDS)
+    jax.block_until_ready(p)
+    dt = time.perf_counter() - t0
+    return TPU_ROUNDS / dt
+
+
+def baseline_rounds_per_sec() -> float:
+    """Reference-shaped round: sequential stations, host serialization hops."""
+    from vantage6_tpu.common.serialization import deserialize, serialize
+    from vantage6_tpu.workloads import fedavg_mnist as W
+
+    cpu = jax.devices("cpu")[0]
+    x, y = W.synthetic_image_classes(N_STATIONS * N_PER_STATION, seed=0)
+    key = jax.random.key(0)
+    with jax.default_device(cpu):
+        params = W.init_params(jax.random.fold_in(key, 1))
+
+        def local_train(params, sx, sy, seed):
+            k = jax.random.key(seed)
+
+            def step(p, sk):
+                idx = jax.random.randint(sk, (BATCH,), 0, sx.shape[0])
+                bx, by = jnp.take(sx, idx, axis=0), jnp.take(sy, idx, axis=0)
+                g = jax.grad(
+                    lambda q: W.weighted_ce_loss(q, bx, by, jnp.ones(BATCH))
+                )(p)
+                return jax.tree.map(lambda a, gg: a - LR * gg, p, g), None
+
+            out, _ = jax.lax.scan(step, params, jax.random.split(k, LOCAL_STEPS))
+            return out
+
+        local_train = jax.jit(local_train)
+        shards = [
+            (
+                jnp.asarray(x[i * N_PER_STATION:(i + 1) * N_PER_STATION]),
+                jnp.asarray(y[i * N_PER_STATION:(i + 1) * N_PER_STATION]),
+            )
+            for i in range(N_STATIONS)
+        ]
+        # warmup compile
+        jax.block_until_ready(local_train(params, shards[0][0], shards[0][1], 0))
+
+        t0 = time.perf_counter()
+        for r in range(BASELINE_ROUNDS):
+            results = []
+            for s, (sx, sy) in enumerate(shards):
+                # task payload hop: serialize global params -> station
+                blob = serialize({"params": params})
+                p_in = deserialize(blob)["params"]
+                p_in = jax.tree.map(jnp.asarray, p_in)
+                new_p = local_train(p_in, sx, sy, r * 1000 + s)
+                # result hop: station -> server
+                results.append(
+                    deserialize(serialize({"params": new_p}))["params"]
+                )
+            params = jax.tree.map(
+                lambda *ps: jnp.mean(jnp.stack([jnp.asarray(p) for p in ps]),
+                                     axis=0),
+                *results,
+            )
+        jax.block_until_ready(jax.tree.leaves(params)[0])
+        dt = time.perf_counter() - t0
+    return BASELINE_ROUNDS / dt
+
+
+def main() -> None:
+    tpu = tpu_rounds_per_sec()
+    base = baseline_rounds_per_sec()
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_rounds_per_sec_32stations_cnn",
+                "value": round(tpu, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(tpu / base, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

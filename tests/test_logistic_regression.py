@@ -1,0 +1,67 @@
+"""Federated logistic regression == pooled fit (the clinical parity claim)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from vantage6_tpu.algorithm import MockAlgorithmClient
+from vantage6_tpu.models.logistic import binary_loss
+from vantage6_tpu.runtime.federation import federation_from_datasets
+from vantage6_tpu.utils.datasets import synthetic_tabular
+from vantage6_tpu.workloads import logistic_regression as L
+
+FEATURES = [f"f{i}" for i in range(6)]
+
+
+def make_dfs(n_stations=3, rows=60, seed=0):
+    x, y = synthetic_tabular(n_stations * rows, n_features=6, seed=seed)
+    dfs = []
+    for i in range(n_stations):
+        sl = slice(i * rows, (i + 1) * rows)
+        df = pd.DataFrame(x[sl], columns=FEATURES)
+        df["outcome"] = y[sl]
+        dfs.append(df)
+    return dfs, x, y
+
+
+def pooled_gd(x, y, n_iter, lr):
+    params = {"w": jnp.zeros((x.shape[1], 1)), "b": jnp.zeros((1,))}
+    xj, yj = jnp.asarray(x), jnp.asarray(y)
+    for _ in range(n_iter):
+        g = jax.grad(lambda p: binary_loss(p, xj, yj))(params)
+        params = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+    return params
+
+
+def test_host_mode_federated_equals_pooled():
+    dfs, x, y = make_dfs()
+    client = MockAlgorithmClient(
+        datasets=[[{"database": d}] for d in dfs], module=L
+    )
+    task = client.task.create(
+        input_={"method": "central_logistic",
+                "kwargs": {"feature_cols": FEATURES, "label_col": "outcome",
+                           "n_iter": 30, "lr": 0.5}},
+        organizations=[0],
+    )
+    (res,) = client.result.get(task["id"])
+    expect = pooled_gd(x, y, 30, 0.5)
+    np.testing.assert_allclose(res["w"], np.asarray(expect["w"]),
+                               rtol=1e-3, atol=1e-5)
+    assert res["n_samples"] == len(x)
+
+
+def test_device_mode_federated_equals_pooled():
+    n_stations, rows = 4, 50
+    x, y = synthetic_tabular(n_stations * rows, n_features=6, seed=2)
+    datasets = []
+    for i in range(n_stations):
+        sl = slice(i * rows, (i + 1) * rows)
+        datasets.append({
+            "x": x[sl], "y": y[sl], "count": np.float32(rows),
+        })
+    fed = federation_from_datasets(datasets, algorithms={"logreg": L})
+    params = L.fit_device(fed, n_features=6, n_iter=40, lr=0.5)
+    expect = pooled_gd(x, y, 40, 0.5)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               np.asarray(expect["w"]), rtol=1e-3, atol=1e-5)

@@ -35,6 +35,26 @@ try:  # jax >= 0.6 top-level; older: experimental
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+# fed_map requires variance checking OFF (see the comment at the call site:
+# with it on, jax auto-psums gradients of replicated inputs across the mesh,
+# silently breaking per-station gradient isolation). Resolve the flag name
+# once here; if a future jax renames it again, fail LOUDLY — running with
+# the check enabled would corrupt federated semantics without any error.
+import inspect as _inspect
+
+_SHARD_MAP_PARAMS = _inspect.signature(shard_map).parameters
+if "check_vma" in _SHARD_MAP_PARAMS:
+    _NO_VMA_KW = {"check_vma": False}
+elif "check_rep" in _SHARD_MAP_PARAMS:  # pragma: no cover - older jax
+    _NO_VMA_KW = {"check_rep": False}
+else:  # pragma: no cover
+    raise RuntimeError(
+        "cannot disable shard_map variance checking (no check_vma/check_rep "
+        "parameter in this jax version) — fed_map's per-station gradient "
+        "isolation would silently break; pin a compatible jax or update "
+        "vantage6_tpu.core.mesh"
+    )
+
 STATION_AXIS = "station"
 DEVICE_AXIS = "device"
 
@@ -148,11 +168,19 @@ class FederationMesh:
         in_specs = tuple(self.station_spec() for _ in stacked_args) + tuple(
             P() for _ in replicated_args
         )
+        # Variance checking OFF: station blocks are PURELY LOCAL programs.
+        # With it on, replicated (P()) inputs are "unvarying" and jax
+        # auto-inserts a psum over the mesh on any gradient taken w.r.t. them
+        # inside the body — silently turning each station's local gradient
+        # into the cross-station sum (breaking the federated privacy/
+        # isolation contract, not just numerics). All cross-station reduction
+        # happens explicitly, outside fed_map, via fed.collectives.
         return shard_map(
             block_fn,
             mesh=self.mesh,
             in_specs=in_specs,
             out_specs=self.station_spec(),
+            **_NO_VMA_KW,
         )(*stacked_args, *replicated_args)
 
     def __repr__(self) -> str:  # pragma: no cover

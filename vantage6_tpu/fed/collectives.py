@@ -39,9 +39,16 @@ def _norm_weights(
 
 
 def _weighted_leaf_sum(x: jax.Array, w: jax.Array) -> jax.Array:
-    """sum_i w[i] * x[i] over the leading (station) axis."""
+    """sum_i w[i] * x[i] over the leading (station) axis.
+
+    Zero-weight stations are excluded with `where`, not just multiplied by
+    0 — a crashed/diverged station whose contribution is inf/nan must not
+    poison the aggregate (nan * 0 == nan). This is what makes participation
+    masks a real failure-isolation mechanism.
+    """
     ww = w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
-    return jnp.sum(x * ww, axis=0)
+    safe_x = jnp.where(ww != 0, x, jnp.zeros((), x.dtype))
+    return jnp.sum(safe_x * ww, axis=0)
 
 
 def fed_sum(stacked: Pytree, mask: jax.Array | None = None) -> Pytree:

@@ -1,0 +1,51 @@
+"""2-layer CNN — the flagship FedAvg model (BASELINE.md workload 3).
+
+The reference has no models at all (math lives in external algorithm
+containers, SURVEY.md §1); this is the TPU-native counterpart of the CNN an
+algorithm repo would ship for FedAvg-MNIST. bfloat16 activations keep the
+convs on the MXU; params stay float32 for stable aggregation across stations.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class CNN(nn.Module):
+    """conv(32) -> pool -> conv(64) -> pool -> dense(128) -> dense(classes)."""
+
+    num_classes: int = 10
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def init_cnn(key: jax.Array, input_shape=(1, 28, 28, 1), num_classes=10):
+    model = CNN(num_classes=num_classes)
+    params = model.init(key, jnp.zeros(input_shape, jnp.float32))["params"]
+    return model, params
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=1) == labels).astype(jnp.float32))

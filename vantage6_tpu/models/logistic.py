@@ -1,0 +1,56 @@
+"""Logistic / linear models for tabular federated analysis.
+
+Counterpart of the reference's v6-logistic-regression-py workload
+(BASELINE.md workload 2) — there, each organization runs sklearn-ish local
+steps and the central task averages coefficients; here the model is a jax
+pytree usable in both host-mode partials and the device-mode FedAvg engine.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, jax.Array]
+
+
+def init_logistic(key: jax.Array, n_features: int, n_classes: int = 2) -> Params:
+    """Binary (n_classes=2 -> single logit) or multinomial logistic params."""
+    out = 1 if n_classes == 2 else n_classes
+    return {
+        "w": jax.random.normal(key, (n_features, out)) * 0.01,
+        "b": jnp.zeros((out,)),
+    }
+
+
+def logits(params: Params, x: jax.Array) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+def binary_loss(params: Params, x: jax.Array, y: jax.Array,
+                l2: float = 0.0) -> jax.Array:
+    """Mean negative log-likelihood, y in {0,1}, optional L2."""
+    z = logits(params, x)[:, 0]
+    nll = jnp.mean(jnp.logaddexp(0.0, z) - y * z)
+    return nll + l2 * jnp.sum(params["w"] ** 2)
+
+
+def multinomial_loss(params: Params, x: jax.Array, y: jax.Array,
+                     l2: float = 0.0) -> jax.Array:
+    logp = jax.nn.log_softmax(logits(params, x))
+    nll = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    return nll + l2 * jnp.sum(params["w"] ** 2)
+
+
+def predict_proba(params: Params, x: jax.Array) -> jax.Array:
+    z = logits(params, x)
+    if z.shape[1] == 1:
+        p = jax.nn.sigmoid(z[:, 0])
+        return jnp.stack([1 - p, p], axis=1)
+    return jax.nn.softmax(z)
+
+
+def binary_accuracy(params: Params, x: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.mean(((logits(params, x)[:, 0] > 0) == (y > 0.5)).astype(
+        jnp.float32))
