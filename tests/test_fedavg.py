@@ -56,7 +56,7 @@ def test_participation_mask_drops_station(mesh, small_engine, fed_data):
     params = W.init_params(key)
     mask = np.ones(8, np.float32)
     mask[3] = 0.0
-    out1 = small_engine.round(params, None or small_engine.init(params), sx,
+    out1 = small_engine.round(params, small_engine.init(params), sx,
                               sy, counts, key, mask=jax.numpy.asarray(mask))
     garbage = np.asarray(sx).copy()
     garbage[3] = 1e6

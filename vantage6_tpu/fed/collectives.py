@@ -156,6 +156,7 @@ def secure_sum(
     stacked: jax.Array,
     key: jax.Array,
     scale: float = 2.0**16,
+    mask: jax.Array | None = None,
 ) -> jax.Array:
     """Secure sum over the station axis via pairwise additive masking.
 
@@ -165,10 +166,22 @@ def secure_sum(
     threat model), then summed; masks cancel exactly in int32 modular
     arithmetic. Returns the dequantized float sum. Max representable |sum| is
     2^31/scale; pick ``scale`` to trade range vs precision.
+
+    ``mask`` ([S]) zeroes non-participating stations' VALUES while every
+    station still contributes its pairwise PRG masks — cancellation needs all
+    mask pairs present (in a real dropout scenario, recovering lost masks
+    requires the Bonawitz secret-sharing protocol; in SPMD all stations are
+    always able to compute their masks, so exclusion-by-mask is exact).
     """
     s = stacked.shape[0]
+    vals = stacked
+    if mask is not None:
+        m = jnp.asarray(mask, stacked.dtype).reshape(
+            (-1,) + (1,) * (stacked.ndim - 1)
+        )
+        vals = jnp.where(m != 0, stacked, jnp.zeros((), stacked.dtype)) * m
     q = jax.vmap(lambda i, x: mask_station_value(key, i, s, quantize(x, scale)))(
-        jnp.arange(s), stacked
+        jnp.arange(s), vals
     )
     return dequantize(jnp.sum(q, axis=0), scale)
 

@@ -151,19 +151,28 @@ class FedAvg:
         key: jax.Array,
         n_rounds: int,
         mask: jax.Array | None = None,
+        opt_state: Any = None,
     ):
         """`n_rounds` federated rounds as ONE compiled program (lax.scan) —
-        the benchmark fast path. Returns (params, opt_state, losses[n])."""
+        the benchmark fast path. Returns (params, opt_state, losses[n]).
+
+        Pass the ``opt_state`` from a checkpoint to CONTINUE a run (resuming
+        FedAdam etc. without resetting server-optimizer moments); omitted, a
+        fresh optimizer state is initialized.
+        """
         if mask is None:
             mask = jnp.ones_like(counts)
+        if opt_state is None:
+            opt_state = self.init(params)
         return self._run(
-            params, stacked_x, stacked_y, counts, mask, key, n_rounds=n_rounds
+            params, opt_state, stacked_x, stacked_y, counts, mask, key,
+            n_rounds=n_rounds,
         )
 
     def _run_impl(
-        self, params, stacked_x, stacked_y, counts, mask, key, *, n_rounds: int
+        self, params, opt_state, stacked_x, stacked_y, counts, mask, key,
+        *, n_rounds: int
     ):
-        opt_state = self.init(params)
 
         def body(carry, round_key):
             p, s = carry

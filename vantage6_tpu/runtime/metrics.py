@@ -1,0 +1,81 @@
+"""Structured per-round metrics + profiling hooks.
+
+The reference's observability is logs only (SURVEY.md §5); this adds the
+structured layer the BASELINE methodology needs: JSONL round metrics
+(rounds/sec, per-round step time, loss) and optional jax profiler traces
+(perfetto) around chosen rounds.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Iterator
+
+import jax
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics, one object per event."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a", buffering=1)
+        self._round_t0: float | None = None
+
+    def log(self, event: str, **fields: Any) -> None:
+        rec = {"ts": time.time(), "event": event, **fields}
+        self._fh.write(json.dumps(rec, default=_tolerant) + "\n")
+
+    @contextlib.contextmanager
+    def round_timer(self, round_index: int) -> Iterator[None]:
+        t0 = time.perf_counter()
+        yield
+        dt = time.perf_counter() - t0
+        self.log("round", round=round_index, seconds=dt,
+                 rounds_per_sec=1.0 / dt if dt > 0 else None)
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _tolerant(obj: Any) -> Any:
+    try:
+        import numpy as np
+
+        if isinstance(obj, (np.generic, np.ndarray)):
+            return obj.tolist()
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(obj, jax.Array):
+        return obj.tolist()
+    return str(obj)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
+    """jax profiler trace (view in perfetto / tensorboard).
+
+    Wrap a round or a run_rounds call; no-op when disabled so call sites can
+    leave it in place unconditionally.
+    """
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def read_jsonl(path: str | Path) -> list[dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

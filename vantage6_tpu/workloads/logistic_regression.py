@@ -51,6 +51,8 @@ def central_logistic(client: Any, feature_cols: list[str], label_col: str,
                      n_iter: int = 50, lr: float = 1.0,
                      organizations: list[int] | None = None) -> dict[str, Any]:
     """Federated full-batch gradient descent — identical to pooled GD."""
+    if n_iter < 1:
+        raise ValueError("n_iter must be >= 1")
     orgs = organizations or [o["id"] for o in client.organization.list()]
     n_features = len(feature_cols)
     params = {"w": np.zeros((n_features, 1), np.float32),

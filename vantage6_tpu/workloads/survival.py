@@ -1,0 +1,259 @@
+"""Federated survival analysis: Kaplan-Meier + Cox proportional hazards.
+
+Parity targets (SURVEY.md §2 item 28, BASELINE.md workloads 4-5): IKNL's
+v6-kaplan-meier-py and federated Cox (WebDISCO-style — Lu et al., the
+federated Cox used in the vantage6 ecosystem). Stations never share rows;
+they share per-time-grid aggregate statistics, which an all-reduce over the
+station axis combines. All device-mode computations use a FIXED global time
+grid so shapes stay static for SPMD (SURVEY.md §7 hard part 4); the grid is
+exchanged up front exactly like the reference's shared event-time lists
+(same privacy tradeoff, stated rather than hidden).
+
+Math (Breslow ties):
+- KM: S(t) = prod_{t_k <= t} (1 - d_k / n_k), d_k events at t_k, n_k at risk.
+- Cox partial-likelihood score/Hessian per distinct event time t_k with
+  S0 = sum_{at risk} w, S1 = sum x w, S2 = sum x x^T w, w = exp(x beta):
+  g = sum_k [ s_k - d_k S1_k/S0_k ],
+  H = -sum_k d_k [ S2_k/S0_k - (S1_k/S0_k)(S1_k/S0_k)^T ],
+  with s_k = sum of covariates of events at t_k. Newton: beta -= H^{-1} g.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vantage6_tpu.algorithm.decorators import (
+    algorithm_client,
+    data,
+    device_step,
+)
+from vantage6_tpu.fed.collectives import fed_sum, secure_sum
+
+
+# =========================================================== Kaplan-Meier
+@data(1)
+def partial_km_counts(df: Any, time_col: str, event_col: str,
+                      grid: list[float]) -> dict[str, Any]:
+    """Host mode: per-grid-time event and at-risk counts for this station."""
+    t = df[time_col].to_numpy(np.float64)
+    e = df[event_col].to_numpy(np.float64)
+    g = np.asarray(grid, np.float64)
+    events = ((t[None, :] == g[:, None]) * e[None, :]).sum(axis=1)
+    at_risk = (t[None, :] >= g[:, None]).sum(axis=1).astype(np.float64)
+    return {"events": events, "at_risk": at_risk}
+
+
+@data(1)
+def get_unique_event_times(df: Any, time_col: str, event_col: str) -> list:
+    """Host mode: this station's distinct event times (the reference's KM
+    shares these; documented privacy tradeoff)."""
+    t = df[time_col].to_numpy(np.float64)
+    e = df[event_col].to_numpy(bool)
+    return sorted(set(t[e].tolist()))
+
+
+@algorithm_client
+def central_kaplan_meier(client: Any, time_col: str, event_col: str,
+                         organizations=None) -> dict[str, Any]:
+    """Reference-shaped central KM: union event-time grid, then counts."""
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    t1 = client.task.create(
+        input_={"method": "get_unique_event_times",
+                "kwargs": {"time_col": time_col, "event_col": event_col}},
+        organizations=orgs,
+    )
+    times = sorted({t for r in client.wait_for_results(t1["id"]) for t in r})
+    t2 = client.task.create(
+        input_={"method": "partial_km_counts",
+                "kwargs": {"time_col": time_col, "event_col": event_col,
+                           "grid": times}},
+        organizations=orgs,
+    )
+    results = client.wait_for_results(t2["id"])
+    events = np.sum([r["events"] for r in results], axis=0)
+    at_risk = np.sum([r["at_risk"] for r in results], axis=0)
+    surv = np.cumprod(1.0 - np.divide(events, at_risk,
+                                      out=np.zeros_like(events),
+                                      where=at_risk > 0))
+    return {"time": list(times), "survival": surv.tolist(),
+            "events": events.tolist(), "at_risk": at_risk.tolist()}
+
+
+@device_step
+def partial_km_device(data_: Any, grid: Any) -> dict[str, Any]:
+    """Device mode: [K] event/at-risk counts on a fixed grid; padded rows
+    masked. data_ = {"time": [n], "event": [n], "count": []}."""
+    t, e, count = data_["time"], data_["event"], data_["count"]
+    valid = (jnp.arange(t.shape[0]) < count).astype(jnp.float32)
+    g = jnp.asarray(grid, jnp.float32)
+    events = jnp.sum((t[None, :] == g[:, None]) * e[None, :] * valid[None, :],
+                     axis=1)
+    at_risk = jnp.sum((t[None, :] >= g[:, None]) * valid[None, :], axis=1)
+    return {"events": events, "at_risk": at_risk}
+
+
+def km_from_counts(events: jax.Array, at_risk: jax.Array) -> jax.Array:
+    frac = jnp.where(at_risk > 0, events / jnp.maximum(at_risk, 1.0), 0.0)
+    return jnp.cumprod(1.0 - frac)
+
+
+def kaplan_meier_device(
+    federation: Any,
+    grid: np.ndarray,
+    secure: bool = False,
+    key: jax.Array | None = None,
+) -> dict[str, Any]:
+    """Drive device-mode KM; `secure=True` routes counts through the
+    additive-masking secure sum (BASELINE workload 5's aggregation mode)."""
+    from vantage6_tpu.algorithm.client import AlgorithmClient
+
+    client = AlgorithmClient(federation, image="survival")
+    task = client.task.create(
+        input_={"method": "partial_km_device",
+                "kwargs": {"grid": [float(t) for t in grid]}},
+        organizations=federation.organization_ids(),
+    )
+    stacked, mask = client.wait_for_stacked_result(task["id"])
+    if secure:
+        if key is None:
+            raise ValueError(
+                "secure=True requires an explicit masking key — a default "
+                "constant key would make the masks trivially strippable "
+                "(see docs/THREAT_MODEL.md)"
+            )
+        events = secure_sum(stacked["events"], key, scale=2.0**8, mask=mask)
+        at_risk = secure_sum(stacked["at_risk"],
+                             jax.random.fold_in(key, 1), scale=2.0**8,
+                             mask=mask)
+    else:
+        events = fed_sum(stacked["events"], mask=mask)
+        at_risk = fed_sum(stacked["at_risk"], mask=mask)
+    surv = km_from_counts(events, at_risk)
+    return {"time": np.asarray(grid), "survival": np.asarray(surv),
+            "events": np.asarray(events), "at_risk": np.asarray(at_risk)}
+
+
+# ================================================================= Cox PH
+def _cox_station_stats(x, t, e, valid, beta, grid):
+    """[K]-grid risk-set statistics for one station at coefficients beta."""
+    xb = x @ beta
+    w = jnp.exp(xb) * valid
+    g = jnp.asarray(grid, jnp.float32)
+    at_risk = (t[None, :] >= g[:, None]).astype(jnp.float32)  # [K, n]
+    ev_at = (t[None, :] == g[:, None]) * e[None, :] * valid[None, :]  # [K, n]
+    s0 = at_risk @ w                                   # [K]
+    s1 = (at_risk * w[None, :]) @ x                    # [K, d]
+    # S2: sum_i r_ki w_i x_i x_i^T  -> [K, d, d]
+    s2 = jnp.einsum("kn,n,nd,ne->kde", at_risk, w, x, x)
+    d_k = jnp.sum(ev_at, axis=1)                       # [K]
+    s_k = ev_at @ x                                    # [K, d]
+    return {"s0": s0, "s1": s1, "s2": s2, "d": d_k, "s": s_k}
+
+
+@device_step
+def partial_cox_stats(data_: Any, beta: Any, grid: Any) -> dict[str, Any]:
+    """Device mode: per-station Cox risk-set statistics (WebDISCO payload).
+
+    data_ = {"x": [n,d], "time": [n], "event": [n], "count": []}.
+    """
+    x, t, e, count = data_["x"], data_["time"], data_["event"], data_["count"]
+    valid = (jnp.arange(t.shape[0]) < count).astype(jnp.float32)
+    return _cox_station_stats(x, t, e.astype(jnp.float32), valid,
+                              jnp.asarray(beta), grid)
+
+
+def cox_newton_update(agg: dict[str, jax.Array], beta: jax.Array,
+                      ridge: float = 1e-6):
+    """One Newton-Raphson step from aggregated risk-set statistics."""
+    s0 = jnp.maximum(agg["s0"], 1e-12)
+    mean = agg["s1"] / s0[:, None]                       # [K, d]
+    grad = jnp.sum(agg["s"] - agg["d"][:, None] * mean, axis=0)
+    cov = agg["s2"] / s0[:, None, None] - jnp.einsum(
+        "kd,ke->kde", mean, mean
+    )
+    hess = -jnp.sum(agg["d"][:, None, None] * cov, axis=0)
+    hess = hess - ridge * jnp.eye(beta.shape[0])
+    new_beta = beta - jnp.linalg.solve(hess, grad)
+    return new_beta, grad
+
+
+def fit_cox_device(
+    federation: Any,
+    n_features: int,
+    grid: np.ndarray,
+    n_iter: int = 10,
+) -> dict[str, Any]:
+    """Federated Cox via Newton-Raphson; per-iteration payload is the
+    aggregated [K]-grid statistics, reduced on device."""
+    from vantage6_tpu.algorithm.client import AlgorithmClient
+
+    if n_iter < 1:
+        raise ValueError("n_iter must be >= 1")
+    client = AlgorithmClient(federation, image="survival")
+    beta = jnp.zeros((n_features,))
+    grid_l = [float(t) for t in grid]
+    last_grad = None
+    for _ in range(n_iter):
+        task = client.task.create(
+            input_={"method": "partial_cox_stats",
+                    "kwargs": {"beta": beta, "grid": grid_l}},
+            organizations=federation.organization_ids(),
+        )
+        stacked, mask = client.wait_for_stacked_result(task["id"])
+        agg = {k: fed_sum(v, mask=mask) for k, v in stacked.items()}
+        beta, last_grad = cox_newton_update(agg, beta)
+    return {"beta": np.asarray(beta),
+            "grad_norm": float(jnp.linalg.norm(last_grad))}
+
+
+# ------------------------------------------------- host-mode Cox (parity)
+@data(1)
+def partial_cox_stats_host(df: Any, beta: list[float], grid: list[float],
+                           feature_cols: list[str], time_col: str,
+                           event_col: str) -> dict[str, Any]:
+    """Host mode: same statistics from a pandas DataFrame."""
+    x = jnp.asarray(df[feature_cols].to_numpy(np.float32))
+    t = jnp.asarray(df[time_col].to_numpy(np.float32))
+    e = jnp.asarray(df[event_col].to_numpy(np.float32))
+    valid = jnp.ones_like(t)
+    out = _cox_station_stats(x, t, e, valid, jnp.asarray(beta, jnp.float32),
+                             grid)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+@algorithm_client
+def central_cox(client: Any, feature_cols: list[str], time_col: str,
+                event_col: str, n_iter: int = 10,
+                organizations=None) -> dict[str, Any]:
+    """Reference-shaped central Cox: share event-time grid, iterate Newton."""
+    if n_iter < 1:
+        raise ValueError("n_iter must be >= 1")
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    t1 = client.task.create(
+        input_={"method": "get_unique_event_times",
+                "kwargs": {"time_col": time_col, "event_col": event_col}},
+        organizations=orgs,
+    )
+    grid = sorted({t for r in client.wait_for_results(t1["id"]) for t in r})
+    beta = np.zeros(len(feature_cols), np.float32)
+    for _ in range(n_iter):
+        task = client.task.create(
+            input_={"method": "partial_cox_stats_host",
+                    "kwargs": {"beta": beta.tolist(), "grid": grid,
+                               "feature_cols": feature_cols,
+                               "time_col": time_col,
+                               "event_col": event_col}},
+            organizations=orgs,
+        )
+        results = client.wait_for_results(task["id"])
+        agg = {
+            k: jnp.asarray(np.sum([np.asarray(r[k]) for r in results], axis=0))
+            for k in ("s0", "s1", "s2", "d", "s")
+        }
+        new_beta, grad = cox_newton_update(agg, jnp.asarray(beta))
+        beta = np.asarray(new_beta)
+    return {"beta": beta.tolist(), "event_times": grid,
+            "grad_norm": float(jnp.linalg.norm(grad))}
