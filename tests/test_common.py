@@ -1,0 +1,163 @@
+"""Tests for the common layer: encryption, contexts, artifact refs, logging."""
+import logging
+
+import pytest
+
+from vantage6_tpu.common.artifact import (
+    content_digest,
+    digests_match,
+    parse_ref,
+    same_artifact,
+)
+from vantage6_tpu.common.context import (
+    ConfigurationError,
+    ConfigurationManager,
+    NodeContext,
+    ServerContext,
+)
+from vantage6_tpu.common.encryption import CryptorBase, DummyCryptor, RSACryptor
+from vantage6_tpu.common.log import setup_logging
+
+
+@pytest.fixture(scope="module")
+def rsa_dir(tmp_path_factory):
+    return tmp_path_factory.mktemp("rsa")
+
+
+@pytest.fixture(scope="module")
+def rsa_pair(rsa_dir):
+    # 4096-bit keygen is slow; one pair for the whole module.
+    a = RSACryptor(rsa_dir / "a.pem")
+    b = RSACryptor(rsa_dir / "b.pem")
+    return a, b
+
+
+class TestEncryption:
+    def test_dummy_roundtrip(self):
+        c = DummyCryptor()
+        blob = b'{"method": "average"}'
+        wire = c.encrypt_bytes_to_str(blob, "")
+        assert isinstance(wire, str)
+        assert c.decrypt_str_to_bytes(wire) == blob
+
+    def test_rsa_roundtrip_between_orgs(self, rsa_pair):
+        alice, bob = rsa_pair
+        blob = b"federated weights " * 100
+        wire = alice.encrypt_bytes_to_str(blob, bob.public_key_str)
+        assert wire != CryptorBase.bytes_to_str(blob)
+        assert bob.decrypt_str_to_bytes(wire) == blob
+
+    def test_wrong_recipient_fails(self, rsa_pair):
+        alice, bob = rsa_pair
+        wire = alice.encrypt_bytes_to_str(b"secret", alice.public_key_str)
+        with pytest.raises(Exception):
+            bob.decrypt_str_to_bytes(wire)
+
+    def test_tamper_detected(self, rsa_pair):
+        alice, bob = rsa_pair
+        wire = alice.encrypt_bytes_to_str(b"secret", bob.public_key_str)
+        head, _, tail = wire.rpartition("$")
+        tampered = head + "$" + ("A" * len(tail))
+        with pytest.raises(Exception):
+            bob.decrypt_str_to_bytes(tampered)
+
+    def test_key_persistence(self, rsa_dir, rsa_pair):
+        a, _ = rsa_pair
+        again = RSACryptor(rsa_dir / "a.pem")
+        assert again.public_key_str == a.public_key_str
+        assert a.verify_public_key(again.public_key_str)
+        # created 0600 from the first instant
+        assert (rsa_dir / "a.pem").stat().st_mode & 0o777 == 0o600
+
+    def test_malformed_payload(self, rsa_pair):
+        a, _ = rsa_pair
+        with pytest.raises(ValueError, match="malformed"):
+            a.decrypt_str_to_bytes("notthreeparts")
+
+
+class TestArtifactRef:
+    def test_parse_full(self):
+        r = parse_ref(
+            "harbor2.vantage6.ai/algorithms/average:4.0@sha256:" + "ab" * 32
+        )
+        assert r.registry == "harbor2.vantage6.ai"
+        assert r.name == "algorithms/average"
+        assert r.tag == "4.0"
+        assert r.digest.startswith("sha256:")
+        assert parse_ref(r.full) == r
+
+    def test_bare_name_with_tag(self):
+        r = parse_ref("v6-average-py:latest")
+        assert r.registry == "" and r.name == "v6-average-py"
+
+    def test_registry_heuristic(self):
+        # no dot/port -> it's a path component, not a registry
+        r = parse_ref("algorithms/average")
+        assert r.registry == "" and r.name == "algorithms/average"
+
+    def test_digest_check(self):
+        blob = b"algorithm module bytes"
+        ref = f"average@{content_digest(blob)}"
+        assert digests_match(ref, blob)
+        assert not digests_match(ref, b"tampered")
+        assert digests_match("average:1.0", b"anything")  # unpinned
+
+    def test_same_artifact_ignores_digest_and_defaults_latest(self):
+        assert same_artifact("avg", "avg:latest")
+        assert same_artifact("avg:1.0@sha256:" + "0" * 64, "avg:1.0")
+        assert not same_artifact("avg:1.0", "avg:2.0")
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_ref("UPPER CASE BAD!!")
+
+
+class TestContexts:
+    def test_node_context_requires_keys(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "cfg"))
+        monkeypatch.setenv("XDG_DATA_HOME", str(tmp_path / "data"))
+        monkeypatch.setenv("XDG_STATE_HOME", str(tmp_path / "state"))
+        with pytest.raises(ConfigurationError, match="api_url"):
+            NodeContext.create("n1", {"api_key": "k"})
+        ctx = NodeContext.create(
+            "n1", {"api_url": "http://localhost:7601", "api_key": "k"}
+        )
+        assert ctx.api_url == "http://localhost:7601"
+        assert NodeContext.config_exists("n1")
+        assert NodeContext.available_configurations() == ["n1"]
+        # data/log dirs materialize under XDG roots
+        assert ctx.data_dir.is_dir() and ctx.log_dir.is_dir()
+
+    def test_server_context_defaults(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "cfg"))
+        monkeypatch.setenv("XDG_DATA_HOME", str(tmp_path / "data"))
+        monkeypatch.setenv("XDG_STATE_HOME", str(tmp_path / "state"))
+        ctx = ServerContext.create("s1", {})
+        assert ctx.port == ServerContext.DEFAULT_PORT
+        assert ctx.uri.startswith("sqlite:///")
+
+    def test_env_interpolation(self, monkeypatch):
+        monkeypatch.setenv("SECRET_DB", "/data/x.csv")
+        raw = {"api_url": "u", "api_key": "k", "databases": [{"uri": "${SECRET_DB}"}]}
+        cfg = ConfigurationManager("node").validate(raw)
+        assert cfg["databases"][0]["uri"] == "/data/x.csv"
+        # the caller's dict keeps its placeholder (saved configs must not
+        # leak resolved secrets)
+        assert raw["databases"][0]["uri"] == "${SECRET_DB}"
+
+    def test_duplicate_create_rejected(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "cfg"))
+        monkeypatch.setenv("XDG_DATA_HOME", str(tmp_path / "data"))
+        monkeypatch.setenv("XDG_STATE_HOME", str(tmp_path / "state"))
+        ServerContext.create("dup", {})
+        with pytest.raises(ConfigurationError, match="exists"):
+            ServerContext.create("dup", {})
+
+
+def test_setup_logging_idempotent(tmp_path):
+    lg1 = setup_logging("v6t-test", level=logging.DEBUG, log_dir=tmp_path)
+    n = len(lg1.handlers)
+    lg2 = setup_logging("v6t-test", log_dir=tmp_path)
+    assert lg2 is lg1 and len(lg2.handlers) == n
+    lg1.info("hello file")
+    assert any(tmp_path.glob("*.log"))

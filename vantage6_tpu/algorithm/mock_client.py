@@ -28,7 +28,6 @@ from types import ModuleType
 from typing import Any, Callable
 
 from vantage6_tpu.algorithm.client import AlgorithmClient
-from vantage6_tpu.runtime.federation import federation_from_datasets
 
 
 class MockAlgorithmClient(AlgorithmClient):
@@ -57,6 +56,10 @@ class MockAlgorithmClient(AlgorithmClient):
             per_org.append(
                 first["database"] if isinstance(first, dict) else first
             )
+        # Imported here, not at module top: algorithm/__init__ loads this
+        # module, and runtime.federation imports the algorithm package.
+        from vantage6_tpu.runtime.federation import federation_from_datasets
+
         fed = federation_from_datasets(
             per_org, algorithms={"mock": module}, devices=devices
         )

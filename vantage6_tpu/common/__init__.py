@@ -1,0 +1,2 @@
+"""Shared base layer (parity: vantage6-common, SURVEY.md §2 items 21-25)."""
+from vantage6_tpu.common.enums import TaskStatus  # noqa: F401

@@ -1,0 +1,151 @@
+"""End-to-end payload encryption between organizations.
+
+Parity: vantage6-common's encryption module (SURVEY.md §2 item 21) — the
+reference encrypts task inputs/results end-to-end so the server only ever
+relays ciphertext: a fresh symmetric key per payload, sealed with the
+*recipient organization's* RSA public key, with a ``DummyCryptor`` drop-in
+when a collaboration is not encrypted.
+
+Scheme here: RSA-OAEP(SHA-256) seals a fresh 256-bit key; the payload itself
+is AES-256-GCM (authenticated — tampering with a relayed blob is detected,
+which the reference's CTR mode does not give). Wire format is
+``base64(sealed_key) $ base64(nonce) $ base64(ciphertext)`` so blobs remain
+printable JSON-safe strings like the reference's.
+"""
+from __future__ import annotations
+
+import base64
+import os
+from pathlib import Path
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+SEPARATOR = "$"
+
+
+class CryptorBase:
+    """Common base: byte<->str helpers shared by real and dummy cryptors."""
+
+    @staticmethod
+    def bytes_to_str(data: bytes) -> str:
+        return base64.b64encode(data).decode("ascii")
+
+    @staticmethod
+    def str_to_bytes(data: str) -> bytes:
+        return base64.b64decode(data.encode("ascii"))
+
+    def encrypt_bytes_to_str(self, data: bytes, pubkey_base64: str) -> str:
+        raise NotImplementedError
+
+    def decrypt_str_to_bytes(self, data: str) -> bytes:
+        raise NotImplementedError
+
+
+class DummyCryptor(CryptorBase):
+    """Pass-through 'cryptor' for unencrypted collaborations (base64 only,
+    so the wire shape is identical either way)."""
+
+    def encrypt_bytes_to_str(self, data: bytes, pubkey_base64: str = "") -> str:
+        return self.bytes_to_str(data)
+
+    def decrypt_str_to_bytes(self, data: str) -> bytes:
+        return self.str_to_bytes(data)
+
+
+class RSACryptor(CryptorBase):
+    """Hybrid RSA-OAEP + AES-256-GCM cryptor bound to one private key.
+
+    ``private_key`` may be an ``rsa.RSAPrivateKey``, a PEM ``bytes`` blob, or
+    a path to a PEM file (created if missing — the reference generates a
+    keypair on first node start the same way).
+    """
+
+    KEY_BITS = 4096
+
+    def __init__(self, private_key: rsa.RSAPrivateKey | bytes | str | Path):
+        if isinstance(private_key, rsa.RSAPrivateKey):
+            self.private_key = private_key
+        elif isinstance(private_key, bytes):
+            self.private_key = serialization.load_pem_private_key(
+                private_key, password=None
+            )
+        else:
+            path = Path(private_key)
+            if not path.exists():
+                path.parent.mkdir(parents=True, exist_ok=True)
+                key = self.create_new_rsa_key()
+                pem = key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption(),
+                )
+                # 0600 from the first instant — no world-readable window.
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+                with os.fdopen(fd, "wb") as f:
+                    f.write(pem)
+            self.private_key = serialization.load_pem_private_key(
+                path.read_bytes(), password=None
+            )
+
+    @classmethod
+    def create_new_rsa_key(cls) -> rsa.RSAPrivateKey:
+        return rsa.generate_private_key(
+            public_exponent=65537, key_size=cls.KEY_BITS
+        )
+
+    # ------------------------------------------------------------- public key
+    @property
+    def public_key_bytes(self) -> bytes:
+        return self.private_key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+
+    @property
+    def public_key_str(self) -> str:
+        return self.bytes_to_str(self.public_key_bytes)
+
+    def verify_public_key(self, pubkey_base64: str) -> bool:
+        """Does the (server-registered) public key match our private key?"""
+        return pubkey_base64 == self.public_key_str
+
+    # -------------------------------------------------------------- transport
+    def encrypt_bytes_to_str(self, data: bytes, pubkey_base64: str) -> str:
+        recipient = serialization.load_pem_public_key(
+            self.str_to_bytes(pubkey_base64)
+        )
+        session_key = AESGCM.generate_key(bit_length=256)
+        nonce = os.urandom(12)
+        ciphertext = AESGCM(session_key).encrypt(nonce, data, None)
+        sealed = recipient.encrypt(
+            session_key,
+            padding.OAEP(
+                mgf=padding.MGF1(algorithm=hashes.SHA256()),
+                algorithm=hashes.SHA256(),
+                label=None,
+            ),
+        )
+        return SEPARATOR.join(
+            self.bytes_to_str(part) for part in (sealed, nonce, ciphertext)
+        )
+
+    def decrypt_str_to_bytes(self, data: str) -> bytes:
+        try:
+            sealed_s, nonce_s, ct_s = data.split(SEPARATOR)
+        except ValueError as e:
+            raise ValueError(
+                "malformed encrypted payload (expected 3 '$'-separated parts)"
+            ) from e
+        session_key = self.private_key.decrypt(
+            self.str_to_bytes(sealed_s),
+            padding.OAEP(
+                mgf=padding.MGF1(algorithm=hashes.SHA256()),
+                algorithm=hashes.SHA256(),
+                label=None,
+            ),
+        )
+        return AESGCM(session_key).decrypt(
+            self.str_to_bytes(nonce_s), self.str_to_bytes(ct_s), None
+        )

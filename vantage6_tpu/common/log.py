@@ -1,0 +1,71 @@
+"""Logging setup: colored console + rotating per-instance file logs.
+
+Parity: vantage6-common logging (SURVEY.md §2 item 24) — every long-running
+instance (server, node, store) logs to its own rotating file under the
+instance's log dir plus a colored console stream.
+"""
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import sys
+from pathlib import Path
+
+_COLORS = {
+    logging.DEBUG: "\033[36m",     # cyan
+    logging.INFO: "\033[32m",      # green
+    logging.WARNING: "\033[33m",   # yellow
+    logging.ERROR: "\033[31m",     # red
+    logging.CRITICAL: "\033[35m",  # magenta
+}
+_RESET = "\033[0m"
+
+FORMAT = "%(asctime)s %(levelname)-8s %(name)s | %(message)s"
+DATEFMT = "%H:%M:%S"
+
+
+class ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        color = _COLORS.get(record.levelno)
+        if color and sys.stderr.isatty():
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def logger_name(special_char: str = "/") -> str:
+    """Module-derived logger name, as the reference's helper does."""
+    import inspect
+
+    frame = inspect.stack()[1]
+    mod = inspect.getmodule(frame[0])
+    return (mod.__name__ if mod else "vantage6_tpu").replace(".", special_char)
+
+
+def setup_logging(
+    name: str = "vantage6_tpu",
+    level: int | str = logging.INFO,
+    log_dir: str | Path | None = None,
+    max_bytes: int = 5 * 1024 * 1024,
+    backup_count: int = 3,
+) -> logging.Logger:
+    """Configure and return the instance logger (idempotent)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_v6t_configured", False):
+        return logger
+    logger.setLevel(level)
+    console = logging.StreamHandler(sys.stderr)
+    console.setFormatter(ColorFormatter(FORMAT, DATEFMT))
+    logger.addHandler(console)
+    if log_dir is not None:
+        path = Path(log_dir)
+        path.mkdir(parents=True, exist_ok=True)
+        fileh = logging.handlers.RotatingFileHandler(
+            path / f"{name.replace('/', '_')}.log",
+            maxBytes=max_bytes,
+            backupCount=backup_count,
+        )
+        fileh.setFormatter(logging.Formatter(FORMAT))
+        logger.addHandler(fileh)
+    logger._v6t_configured = True  # type: ignore[attr-defined]
+    return logger

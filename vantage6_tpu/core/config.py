@@ -190,9 +190,9 @@ def _interp_env(value: str) -> str:
 
 def default_config_dir() -> Path:
     """Well-known per-user config dir (reference uses appdirs)."""
-    base = os.environ.get("XDG_CONFIG_HOME", os.path.expanduser("~/.config"))
-    p = Path(base) / "vantage6_tpu"
-    return p
+    from vantage6_tpu.common.context import config_root
+
+    return config_root()
 
 
 def demo_federation(n_stations: int = 2, name: str = "dev") -> FederationConfig:
