@@ -1,0 +1,2 @@
+"""Control plane (parity: vantage6-server, SURVEY.md §2 items 1-8)."""
+from vantage6_tpu.server.app import ServerApp, run_server  # noqa: F401

@@ -1,0 +1,98 @@
+"""The control-plane server application.
+
+Parity: vantage6-server's `ServerApp`/`run_server` (SURVEY.md §2 item 1):
+bind the database, migrate the schema, seed the rule matrix + default roles,
+ensure a root user, register the REST resources and the event hub, serve.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from vantage6_tpu.common.context import ServerContext
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.server import models
+from vantage6_tpu.server.auth import TokenAuthority
+from vantage6_tpu.server.events import EventHub
+from vantage6_tpu.server.permission import PermissionManager
+from vantage6_tpu.server.resources import register_resources
+from vantage6_tpu.server.web import App, AppServer, TestClient
+
+log = setup_logging("vantage6_tpu/server")
+
+
+class ServerApp:
+    def __init__(
+        self,
+        uri: str = "sqlite:///:memory:",
+        jwt_secret: str | None = None,
+        algorithm_policy: Callable[[str], bool] | None = None,
+    ):
+        self.started_at = time.time()
+        self.db = models.init(uri)
+        self.pm = PermissionManager()
+        self.default_roles = self.pm.ensure_default_roles()
+        self.tokens = TokenAuthority(jwt_secret)
+        self.hub = EventHub()
+        # optional algorithm-store gate: image -> allowed? (SURVEY §2 item 9;
+        # wired up by the store service or a static allow-list)
+        self.algorithm_policy = algorithm_policy
+        self.app = App("vantage6_tpu-server")
+        register_resources(self)
+
+    def close(self) -> None:
+        """Release the database binding (required before a new ServerApp in
+        the same process — see models.init)."""
+        self.db.close()
+        models.Model.db = None
+
+    # ----------------------------------------------------------------- seed
+    def ensure_root(
+        self,
+        username: str = "root",
+        password: str | None = None,
+        organization_name: str = "root",
+    ) -> tuple[models.User, str | None]:
+        """Idempotently create the root org + root user (reference seeds the
+        same at first start). Returns (user, generated_password | None)."""
+        user = models.User.first(username=username)
+        if user is not None:
+            return user, None
+        org = models.Organization.first(name=organization_name)
+        if org is None:
+            org = models.Organization(name=organization_name).save()
+        import secrets
+
+        generated = password or secrets.token_urlsafe(16)
+        user = models.User(username=username, organization_id=org.id)
+        user.set_password(generated)
+        user.save()
+        user.add_role(self.default_roles["Root"])
+        log.info("created root user %r", username)
+        return user, generated
+
+    # ---------------------------------------------------------------- serve
+    def test_client(self) -> TestClient:
+        return TestClient(self.app)
+
+    def serve(
+        self, host: str = "127.0.0.1", port: int = 7601, background: bool = False
+    ) -> AppServer:
+        server = AppServer(self.app, host, port)
+        log.info("serving control plane on %s", server.url)
+        if background:
+            return server.start_background()
+        server.serve_forever()
+        return server
+
+
+def run_server(ctx: ServerContext, background: bool = False) -> AppServer:
+    """Start a server from an instance context (reference: `v6 server start`)."""
+    srv = ServerApp(
+        uri=ctx.uri, jwt_secret=ctx.config.get("jwt_secret") or None
+    )
+    user, generated = srv.ensure_root()
+    if generated:
+        # printed once at first start; operators change it immediately
+        log.warning("root password (first start): %s", generated)
+    return srv.serve(port=ctx.port, background=background)

@@ -1,0 +1,312 @@
+"""Lightweight sqlite3-backed persistence for the control plane.
+
+Parity: the reference persists its control plane through SQLAlchemy ORM
+models (SURVEY.md §2 items 2, 8). SQLAlchemy is not in this image, so this
+module provides the small declarative core the server models need: typed
+columns, foreign keys, many-to-many link tables, and schema migration by
+additive DDL (the reference uses alembic; here `ensure_schema` creates
+missing tables/columns on startup, which covers the same upgrade path for a
+single-writer control plane).
+
+Thread safety: one connection per thread (the WSGI server is threaded);
+sqlite handles cross-process locking.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from pathlib import Path
+from typing import Any, ClassVar, Iterable, TypeVar
+
+T = TypeVar("T", bound="Model")
+
+_TYPES = {
+    "int": "INTEGER",
+    "float": "REAL",
+    "str": "TEXT",
+    "bool": "INTEGER",
+    "json": "TEXT",
+    "blob": "BLOB",
+}
+
+
+class Database:
+    """One sqlite database; thread-local connections."""
+
+    def __init__(self, uri: str = "sqlite:///:memory:"):
+        self.path = uri.removeprefix("sqlite:///") if uri.startswith("sqlite") else uri
+        self._local = threading.local()
+        self._memory_conn: sqlite3.Connection | None = None
+        if self.path != ":memory:":
+            Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        else:
+            # :memory: is per-connection; share ONE connection (+lock) so all
+            # threads see the same in-memory database (test mode).
+            self._memory_conn = self._connect()
+        self._memory_lock = threading.RLock()
+
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.path, check_same_thread=False)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA foreign_keys = ON")
+        conn.execute("PRAGMA journal_mode = WAL")
+        return conn
+
+    @property
+    def conn(self) -> sqlite3.Connection:
+        if self._memory_conn is not None:
+            return self._memory_conn
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = self._connect()
+            self._local.conn = c
+        return c
+
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
+        if self._memory_conn is not None:
+            with self._memory_lock:
+                cur = self.conn.execute(sql, tuple(params))
+                self.conn.commit()
+                return cur
+        cur = self.conn.execute(sql, tuple(params))
+        self.conn.commit()
+        return cur
+
+    def query(self, sql: str, params: Iterable[Any] = ()) -> list[sqlite3.Row]:
+        if self._memory_conn is not None:
+            with self._memory_lock:
+                return self.conn.execute(sql, tuple(params)).fetchall()
+        return self.conn.execute(sql, tuple(params)).fetchall()
+
+    def close(self) -> None:
+        if self._memory_conn is not None:
+            self._memory_conn.close()
+            self._memory_conn = None
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+            self._local.conn = None
+
+
+class Model:
+    """Declarative row: subclasses set TABLE and COLUMNS.
+
+    COLUMNS maps field name -> type key in `_TYPES`; `"<name>_id"` columns
+    ending in `_id` get an index. `id` (PK) and `created_at` are implicit.
+    """
+
+    TABLE: ClassVar[str] = ""
+    COLUMNS: ClassVar[dict[str, str]] = {}
+
+    # Bound per model *hierarchy*: `Model.db = ...` serves the server models;
+    # a service with its own DB (algorithm store) subclasses Model with its
+    # own `db = None` class attribute and binds that instead.
+    db: ClassVar[Database | None] = None
+
+    def __init__(self, **kw: Any):
+        self.id: int | None = kw.pop("id", None)
+        self.created_at: float = kw.pop("created_at", None) or time.time()
+        for col in self.COLUMNS:
+            setattr(self, col, kw.pop(col, None))
+        if kw:
+            raise TypeError(f"{type(self).__name__}: unknown fields {sorted(kw)}")
+
+    # ------------------------------------------------------------------ class
+    @classmethod
+    def _db(cls) -> Database:
+        db = cls.db  # class-attribute lookup: nearest hierarchy binding wins
+        if db is None:
+            raise RuntimeError("no database bound — call db.init(uri) first")
+        return db
+
+    @classmethod
+    def ensure_schema(cls) -> None:
+        cols = ", ".join(
+            f"{name} {_TYPES[t]}" for name, t in cls.COLUMNS.items()
+        )
+        cls._db().execute(
+            f"CREATE TABLE IF NOT EXISTS {cls.TABLE} "
+            f"(id INTEGER PRIMARY KEY AUTOINCREMENT, created_at REAL"
+            + (", " + cols if cols else "")
+            + ")"
+        )
+        # additive migration: add any columns that an older schema lacks
+        have = {
+            r["name"]
+            for r in cls._db().query(f"PRAGMA table_info({cls.TABLE})")
+        }
+        for name, t in cls.COLUMNS.items():
+            if name not in have:
+                cls._db().execute(
+                    f"ALTER TABLE {cls.TABLE} ADD COLUMN {name} {_TYPES[t]}"
+                )
+        for name in cls.COLUMNS:
+            if name.endswith("_id"):
+                cls._db().execute(
+                    f"CREATE INDEX IF NOT EXISTS idx_{cls.TABLE}_{name} "
+                    f"ON {cls.TABLE}({name})"
+                )
+
+    # ------------------------------------------------------------- marshal
+    def _encode(self, col: str) -> Any:
+        v = getattr(self, col)
+        t = self.COLUMNS[col]
+        if v is None:
+            return None
+        if t == "json":
+            return json.dumps(v)
+        if t == "bool":
+            return int(v)
+        return v
+
+    @classmethod
+    def _from_row(cls: type[T], row: sqlite3.Row) -> T:
+        kw: dict[str, Any] = {"id": row["id"], "created_at": row["created_at"]}
+        for col, t in cls.COLUMNS.items():
+            v = row[col]
+            if v is not None and t == "json":
+                v = json.loads(v)
+            elif v is not None and t == "bool":
+                v = bool(v)
+            kw[col] = v
+        return cls(**kw)
+
+    # ----------------------------------------------------------------- CRUD
+    def save(self: T) -> T:
+        cols = list(self.COLUMNS)
+        vals = [self._encode(c) for c in cols]
+        if self.id is None:
+            placeholders = ", ".join("?" for _ in range(len(cols) + 1))
+            cur = self._db().execute(
+                f"INSERT INTO {self.TABLE} (created_at"
+                + (", " + ", ".join(cols) if cols else "")
+                + f") VALUES ({placeholders})",
+                [self.created_at, *vals],
+            )
+            self.id = cur.lastrowid
+        else:
+            sets = ", ".join(f"{c} = ?" for c in cols)
+            self._db().execute(
+                f"UPDATE {self.TABLE} SET {sets} WHERE id = ?",
+                [*vals, self.id],
+            )
+        return self
+
+    def delete(self) -> None:
+        if self.id is not None:
+            self._db().execute(
+                f"DELETE FROM {self.TABLE} WHERE id = ?", [self.id]
+            )
+
+    @classmethod
+    def get(cls: type[T], id_: int) -> T | None:
+        rows = cls._db().query(
+            f"SELECT * FROM {cls.TABLE} WHERE id = ?", [id_]
+        )
+        return cls._from_row(rows[0]) if rows else None
+
+    @classmethod
+    def list(
+        cls: type[T],
+        order: str = "id",
+        limit: int | None = None,
+        offset: int = 0,
+        **where: Any,
+    ) -> list[T]:
+        sql = f"SELECT * FROM {cls.TABLE}"
+        params: list[Any] = []
+        if where:
+            conds = []
+            for k, v in where.items():
+                if v is None:
+                    conds.append(f"{k} IS NULL")
+                else:
+                    conds.append(f"{k} = ?")
+                    params.append(int(v) if isinstance(v, bool) else v)
+            sql += " WHERE " + " AND ".join(conds)
+        sql += f" ORDER BY {order}"
+        if limit is not None:
+            sql += " LIMIT ? OFFSET ?"
+            params += [limit, offset]
+        return [cls._from_row(r) for r in cls._db().query(sql, params)]
+
+    @classmethod
+    def first(cls: type[T], **where: Any) -> T | None:
+        rows = cls.list(limit=1, **where)
+        return rows[0] if rows else None
+
+    @classmethod
+    def count(cls, **where: Any) -> int:
+        sql = f"SELECT COUNT(*) AS n FROM {cls.TABLE}"
+        params: list[Any] = []
+        if where:
+            conds = []
+            for k, v in where.items():
+                if v is None:
+                    conds.append(f"{k} IS NULL")
+                else:
+                    conds.append(f"{k} = ?")
+                    params.append(int(v) if isinstance(v, bool) else v)
+            sql += " WHERE " + " AND ".join(conds)
+        return int(cls._db().query(sql, params)[0]["n"])
+
+
+class LinkTable:
+    """Many-to-many link: two id columns, unique pairs."""
+
+    def __init__(
+        self, table: str, left: str, right: str, base: type[Model] = Model
+    ):
+        self.table, self.left, self.right = table, left, right
+        self.base = base  # which model hierarchy's db binding to use
+
+    def _db(self) -> Database:
+        return self.base._db()
+
+    def ensure_schema(self) -> None:
+        self._db().execute(
+            f"CREATE TABLE IF NOT EXISTS {self.table} ("
+            f"{self.left} INTEGER NOT NULL, {self.right} INTEGER NOT NULL, "
+            f"UNIQUE({self.left}, {self.right}))"
+        )
+
+    def add(self, left_id: int, right_id: int) -> None:
+        self._db().execute(
+            f"INSERT OR IGNORE INTO {self.table} ({self.left}, {self.right}) "
+            "VALUES (?, ?)",
+            [left_id, right_id],
+        )
+
+    def remove(self, left_id: int, right_id: int) -> None:
+        self._db().execute(
+            f"DELETE FROM {self.table} WHERE {self.left} = ? AND {self.right} = ?",
+            [left_id, right_id],
+        )
+
+    def rights_for(self, left_id: int) -> list[int]:
+        return [
+            r[self.right]
+            for r in self._db().query(
+                f"SELECT {self.right} FROM {self.table} WHERE {self.left} = ?",
+                [left_id],
+            )
+        ]
+
+    def lefts_for(self, right_id: int) -> list[int]:
+        return [
+            r[self.left]
+            for r in self._db().query(
+                f"SELECT {self.left} FROM {self.table} WHERE {self.right} = ?",
+                [right_id],
+            )
+        ]
+
+    def exists(self, left_id: int, right_id: int) -> bool:
+        return bool(
+            self._db().query(
+                f"SELECT 1 FROM {self.table} WHERE {self.left} = ? AND {self.right} = ?",
+                [left_id, right_id],
+            )
+        )

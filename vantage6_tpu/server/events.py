@@ -1,0 +1,115 @@
+"""Realtime event hub: rooms, push subscriptions, cursor-based catch-up.
+
+Parity: the reference's SocketIO namespace (SURVEY.md §2 item 6) — rooms per
+collaboration and per node carry `node-online/offline`, `task-created`,
+`status-update`, `kill`, `ping` events between server, nodes and UI. Here
+the hub is transport-neutral: in-process subscribers get push callbacks
+(same-host federations, tests), remote nodes get the events over a
+websocket bridge or by cursor catch-up (`fetch(since=...)` — how a
+reconnecting node re-syncs its missed queue, the reference's
+`sync_task_queue_with_server`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+# canonical event names (reference SocketIO events)
+NODE_ONLINE = "node-online"
+NODE_OFFLINE = "node-offline"
+TASK_CREATED = "task-created"
+STATUS_UPDATE = "status-update"
+KILL_TASK = "kill-task"
+PING = "ping"
+
+
+def collaboration_room(collaboration_id: int) -> str:
+    return f"collaboration_{collaboration_id}"
+
+
+def node_room(node_id: int) -> str:
+    return f"node_{node_id}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    seq: int
+    name: str
+    room: str
+    data: dict[str, Any]
+    ts: float
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class EventHub:
+    """Thread-safe pub/sub with a bounded replay buffer."""
+
+    def __init__(self, buffer_size: int = 4096):
+        self._buffer: deque[Event] = deque(maxlen=buffer_size)
+        self._seq = itertools.count(1)
+        self._lock = threading.RLock()
+        # subscriber id -> (rooms | None for all, callback)
+        self._subs: dict[int, tuple[set[str] | None, Callable[[Event], None]]] = {}
+        self._sub_ids = itertools.count(1)
+
+    # ------------------------------------------------------------------ emit
+    def emit(self, name: str, data: dict[str, Any], room: str = "all") -> Event:
+        with self._lock:
+            ev = Event(
+                seq=next(self._seq), name=name, room=room,
+                data=data, ts=time.time(),
+            )
+            self._buffer.append(ev)
+            subs = list(self._subs.values())
+        for rooms, cb in subs:
+            if rooms is None or room in rooms or room == "all":
+                try:
+                    cb(ev)
+                except Exception:
+                    pass  # a broken subscriber must not break the emitter
+        return ev
+
+    # ------------------------------------------------------------- subscribe
+    def subscribe(
+        self,
+        callback: Callable[[Event], None],
+        rooms: list[str] | None = None,
+    ) -> int:
+        with self._lock:
+            sid = next(self._sub_ids)
+            self._subs[sid] = (set(rooms) if rooms is not None else None, callback)
+            return sid
+
+    def unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    # ---------------------------------------------------------------- replay
+    def fetch(
+        self, since: int = 0, rooms: list[str] | None = None
+    ) -> list[Event]:
+        """Events after sequence `since`, filtered to `rooms` (None = all).
+
+        A node that reconnects calls this with its last-seen cursor to drain
+        whatever it missed.
+        """
+        with self._lock:
+            want = set(rooms) if rooms is not None else None
+            return [
+                ev
+                for ev in self._buffer
+                if ev.seq > since
+                and (want is None or ev.room in want or ev.room == "all")
+            ]
+
+    @property
+    def cursor(self) -> int:
+        """Sequence number of the newest event (0 when empty)."""
+        with self._lock:
+            return self._buffer[-1].seq if self._buffer else 0

@@ -1,0 +1,1021 @@
+"""REST resources: one section per entity, CRUD + pagination + RBAC.
+
+Parity: vantage6-server's resource modules (SURVEY.md §2 item 3) and the
+auth endpoints of item 7. Routes live under `/api/*` with the reference's
+wire shapes (`{"data": [...]}` lists, task fan-out to runs, node PATCH of
+run status/result, kill events, cursor-based event sync).
+"""
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, Any
+
+from vantage6_tpu.common.enums import TaskStatus
+from vantage6_tpu.server import events as ev
+from vantage6_tpu.server import models as m
+from vantage6_tpu.server import schemas as sch
+from vantage6_tpu.server.auth import AuthError, verify_totp
+from vantage6_tpu.server.permission import Operation, Scope
+from vantage6_tpu.server.web import HTTPError, Request
+
+if TYPE_CHECKING:  # pragma: no cover
+    from vantage6_tpu.server.app import ServerApp
+
+
+# --------------------------------------------------------------- auth helpers
+
+
+def _identity(srv: "ServerApp", req: Request) -> tuple[str, Any]:
+    token = req.bearer_token
+    if not token:
+        raise HTTPError(401, "missing bearer token")
+    try:
+        sub = srv.tokens.identity(token)
+    except AuthError as e:
+        raise HTTPError(401, str(e)) from None
+    kind = sub["type"]
+    if kind == "user":
+        user = m.User.get(sub["id"])
+        if user is None:
+            raise HTTPError(401, "unknown user")
+        return "user", user
+    if kind == "node":
+        node = m.Node.get(sub["id"])
+        if node is None:
+            raise HTTPError(401, "unknown node")
+        return "node", node
+    if kind == "container":
+        return "container", sub
+    raise HTTPError(401, "unknown principal type")
+
+
+def _require_user(srv: "ServerApp", req: Request) -> m.User:
+    kind, principal = _identity(srv, req)
+    if kind != "user":
+        raise HTTPError(403, "user credentials required")
+    return principal
+
+
+def _require_node(srv: "ServerApp", req: Request) -> m.Node:
+    kind, principal = _identity(srv, req)
+    if kind != "node":
+        raise HTTPError(403, "node credentials required")
+    return principal
+
+
+def _check(ok: bool) -> None:
+    if not ok:
+        raise HTTPError(403)
+
+
+def _paginate(req: Request, rows: list[Any]) -> dict[str, Any]:
+    start = (req.page - 1) * req.per_page
+    return {
+        "data": [r.to_dict() for r in rows[start : start + req.per_page]],
+        "pagination": {
+            "page": req.page,
+            "per_page": req.per_page,
+            "total": len(rows),
+        },
+    }
+
+
+def _get_or_404(model: type, id_: int) -> Any:
+    row = model.get(id_)
+    if row is None:
+        raise HTTPError(404)
+    return row
+
+
+def _node_for_org(collaboration_id: int, organization_id: int) -> m.Node | None:
+    return m.Node.first(
+        collaboration_id=collaboration_id, organization_id=organization_id
+    )
+
+
+def _container_task(principal: dict[str, Any]) -> m.Task:
+    """The parent task of a container principal; 401 if it was deleted
+    (container tokens outlive task deletion)."""
+    task = m.Task.get(principal["task_id"])
+    if task is None:
+        raise HTTPError(401, "container's task no longer exists")
+    return task
+
+
+def _check_role_grant(user: m.User, role_ids: list[int]) -> list[m.Role]:
+    """A grantor may only hand out roles whose rules they hold themselves —
+    without this, any user-EDIT holder could self-assign Root."""
+    own = user.rule_ids()
+    roles = []
+    for rid in role_ids:
+        role = _get_or_404(m.Role, rid)
+        missing = set(role.rule_ids()) - own
+        if missing:
+            raise HTTPError(
+                403,
+                f"cannot assign role {role.name!r}: it grants rules you "
+                "do not have",
+            )
+        roles.append(role)
+    return roles
+
+
+def register_resources(srv: "ServerApp") -> None:
+    app = srv.app
+    pm = srv.pm
+
+    # ------------------------------------------------------------- service
+    @app.route("/api/health")
+    def health(req: Request):
+        return {"status": "ok", "uptime": time.time() - srv.started_at}
+
+    @app.route("/api/version")
+    def version(req: Request):
+        from vantage6_tpu import __version__
+
+        return {"version": __version__}
+
+    # -------------------------------------------------------------- tokens
+    @app.route("/api/token/user", methods=("POST",))
+    def token_user(req: Request):
+        body = sch.load(sch.TokenUserInput(), req.json)
+        user = m.User.first(username=body["username"])
+        if user is None:
+            raise HTTPError(401, "invalid username or password")
+        if user.is_locked_out():
+            raise HTTPError(401, "account locked, retry later")
+        if not user.check_password(body["password"]):
+            user.record_login(False)
+            raise HTTPError(401, "invalid username or password")
+        if user.totp_secret:
+            code = body.get("mfa_code")
+            if not code or not verify_totp(user.totp_secret, code):
+                user.record_login(False)
+                raise HTTPError(401, "MFA code required or invalid")
+        user.record_login(True)
+        return {**srv.tokens.user_tokens(user.id), "user": user.to_dict()}
+
+    @app.route("/api/token/node", methods=("POST",))
+    def token_node(req: Request):
+        body = sch.load(sch.TokenNodeInput(), req.json)
+        node = m.Node.by_api_key(body["api_key"])
+        if node is None:
+            raise HTTPError(401, "invalid api key")
+        return {**srv.tokens.node_tokens(node.id), "node": node.to_dict()}
+
+    @app.route("/api/token/container", methods=("POST",))
+    def token_container(req: Request):
+        node = _require_node(srv, req)
+        body = sch.load(sch.TokenContainerInput(), req.json)
+        task = _get_or_404(m.Task, body["task_id"])
+        if task.collaboration_id != node.collaboration_id:
+            raise HTTPError(403, "task is not in this node's collaboration")
+        return {
+            "container_token": srv.tokens.container_token(
+                node_id=node.id,
+                task_id=task.id,
+                image=body["image"],
+                organization_id=node.organization_id,
+            )
+        }
+
+    @app.route("/api/token/refresh", methods=("POST",))
+    def token_refresh(req: Request):
+        body = sch.load(sch.RefreshInput(), req.json)
+        try:
+            return srv.tokens.refresh(body["refresh_token"])
+        except AuthError as e:
+            raise HTTPError(401, str(e)) from None
+
+    # --------------------------------------------------------------- users
+    @app.route("/api/user", methods=("GET", "POST"))
+    def users(req: Request):
+        user = _require_user(srv, req)
+        if req.method == "GET":
+            scope = pm.user_scope(user, "user", Operation.VIEW)
+            _check(scope is not None)
+            rows = m.User.list()
+            if scope != Scope.GLOBAL:
+                rows = [
+                    u
+                    for u in rows
+                    if u.organization_id == user.organization_id
+                    or u.id == user.id
+                ]
+            return _paginate(req, rows)
+        body = sch.load(sch.UserInput(), req.json)
+        org_id = body["organization_id"] or user.organization_id
+        _check(pm.allowed(user, "user", Operation.CREATE, organization_id=org_id))
+        if m.User.first(username=body["username"]) is not None:
+            raise HTTPError(409, "username taken")
+        new = m.User(
+            username=body["username"],
+            email=body["email"],
+            firstname=body["firstname"],
+            lastname=body["lastname"],
+            organization_id=org_id,
+        )
+        roles = _check_role_grant(user, body["roles"])
+        new.set_password(body["password"])
+        new.save()
+        for role in roles:
+            m.user_role.add(new.id, role.id)
+        return new.to_dict(), 201
+
+    @app.route("/api/user/<int:id>", methods=("GET", "PATCH", "DELETE"))
+    def user_one(req: Request, id: int):
+        user = _require_user(srv, req)
+        target = _get_or_404(m.User, id)
+        if req.method == "GET":
+            _check(
+                pm.allowed(
+                    user, "user", Operation.VIEW,
+                    organization_id=target.organization_id, owner_id=target.id,
+                )
+                or user.id == target.id
+            )
+            return target.to_dict()
+        if req.method == "DELETE":
+            _check(
+                pm.allowed(
+                    user, "user", Operation.DELETE,
+                    organization_id=target.organization_id, owner_id=target.id,
+                )
+            )
+            target.delete()
+            return {}, 204
+        _check(
+            pm.allowed(
+                user, "user", Operation.EDIT,
+                organization_id=target.organization_id, owner_id=target.id,
+            )
+            or user.id == target.id
+        )
+        body = sch.load(sch.UserPatch(), req.json)
+        for field in ("email", "firstname", "lastname"):
+            if body[field] is not None:
+                setattr(target, field, body[field])
+        if body["password"]:
+            target.set_password(body["password"])
+        if body["roles"] is not None:
+            # assigning roles is an admin action even on yourself
+            _check(
+                pm.allowed(
+                    user, "user", Operation.EDIT,
+                    organization_id=target.organization_id,
+                )
+            )
+            roles = _check_role_grant(user, body["roles"])
+            for rid in set(target.role_ids()):
+                m.user_role.remove(target.id, rid)
+            for role in roles:
+                m.user_role.add(target.id, role.id)
+        target.save()
+        return target.to_dict()
+
+    # ------------------------------------------------------- organizations
+    @app.route("/api/organization", methods=("GET", "POST"))
+    def organizations(req: Request):
+        kind, principal = _identity(srv, req)
+        if req.method == "GET":
+            if kind == "user":
+                scope = pm.user_scope(principal, "organization", Operation.VIEW)
+                _check(scope is not None)
+                rows = m.Organization.list()
+                if scope == Scope.ORGANIZATION:
+                    rows = [
+                        o for o in rows if o.id == principal.organization_id
+                    ]
+                elif scope == Scope.COLLABORATION:
+                    visible: set[int] = {principal.organization_id}
+                    for c in m.Collaboration.list():
+                        ids = c.organization_ids()
+                        if principal.organization_id in ids:
+                            visible.update(ids)
+                    rows = [o for o in rows if o.id in visible]
+                return _paginate(req, rows)
+            # nodes/containers see their collaboration's organizations (needed
+            # for task fan-out and E2E encryption pubkeys)
+            collab_id = (
+                principal.collaboration_id
+                if kind == "node"
+                else _container_task(principal).collaboration_id
+            )
+            ids = m.Collaboration.get(collab_id).organization_ids()
+            rows = [o for o in m.Organization.list() if o.id in ids]
+            return _paginate(req, rows)
+        user = _require_user(srv, req)
+        _check(pm.user_scope(user, "organization", Operation.CREATE) == Scope.GLOBAL)
+        body = sch.load(sch.OrganizationInput(), req.json)
+        org = m.Organization(**body).save()
+        return org.to_dict(), 201
+
+    @app.route("/api/organization/<int:id>", methods=("GET", "PATCH"))
+    def organization_one(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        org = _get_or_404(m.Organization, id)
+        if req.method == "GET":
+            if kind == "user":
+                _check(
+                    pm.allowed(
+                        principal, "organization", Operation.VIEW,
+                        organization_id=org.id,
+                    )
+                    or any(
+                        principal.organization_id in c.organization_ids()
+                        and org.id in c.organization_ids()
+                        for c in m.Collaboration.list()
+                    )
+                )
+            return org.to_dict()
+        user = _require_user(srv, req)
+        _check(
+            pm.allowed(user, "organization", Operation.EDIT, organization_id=org.id)
+        )
+        body = sch.load(sch.OrganizationPatch(), req.json)
+        for field, value in body.items():
+            if value is not None:
+                setattr(org, field, value)
+        org.save()
+        return org.to_dict()
+
+    # ------------------------------------------------------ collaborations
+    @app.route("/api/collaboration", methods=("GET", "POST"))
+    def collaborations(req: Request):
+        kind, principal = _identity(srv, req)
+        if req.method == "GET":
+            rows = m.Collaboration.list()
+            if kind == "user":
+                scope = pm.user_scope(principal, "collaboration", Operation.VIEW)
+                _check(scope is not None)
+                if scope != Scope.GLOBAL:
+                    rows = [
+                        c
+                        for c in rows
+                        if principal.organization_id in c.organization_ids()
+                    ]
+            elif kind == "node":
+                rows = [c for c in rows if c.id == principal.collaboration_id]
+            else:
+                raise HTTPError(403)
+            return _paginate(req, rows)
+        user = _require_user(srv, req)
+        _check(
+            pm.user_scope(user, "collaboration", Operation.CREATE) == Scope.GLOBAL
+        )
+        body = sch.load(sch.CollaborationInput(), req.json)
+        collab = m.Collaboration(
+            name=body["name"], encrypted=body["encrypted"]
+        ).save()
+        for oid in body["organization_ids"]:
+            collab.add_organization(_get_or_404(m.Organization, oid))
+        return collab.to_dict(), 201
+
+    @app.route("/api/collaboration/<int:id>", methods=("GET", "PATCH", "DELETE"))
+    def collaboration_one(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        collab = _get_or_404(m.Collaboration, id)
+        if req.method == "GET":
+            if kind == "user":
+                _check(
+                    pm.allowed(
+                        principal, "collaboration", Operation.VIEW,
+                        collaboration_id=collab.id,
+                        organization_id=principal.organization_id
+                        if principal.organization_id in collab.organization_ids()
+                        else None,
+                    )
+                )
+            elif kind == "node":
+                _check(principal.collaboration_id == collab.id)
+            return collab.to_dict()
+        user = _require_user(srv, req)
+        if req.method == "DELETE":
+            _check(
+                pm.user_scope(user, "collaboration", Operation.DELETE)
+                == Scope.GLOBAL
+            )
+            collab.delete()
+            return {}, 204
+        _check(
+            pm.allowed(
+                user, "collaboration", Operation.EDIT, collaboration_id=collab.id
+            )
+        )
+        body = sch.load(sch.CollaborationInput(partial=True), req.json)
+        if body.get("name"):
+            collab.name = body["name"]
+        if "encrypted" in body:
+            collab.encrypted = body["encrypted"]
+        collab.save()
+        for oid in body.get("organization_ids") or []:
+            collab.add_organization(_get_or_404(m.Organization, oid))
+        return collab.to_dict()
+
+    # -------------------------------------------------------------- studies
+    @app.route("/api/study", methods=("GET", "POST"))
+    def studies(req: Request):
+        user = _require_user(srv, req)
+        if req.method == "GET":
+            scope = pm.user_scope(user, "study", Operation.VIEW)
+            _check(scope is not None)
+            rows = m.Study.list()
+            if scope != Scope.GLOBAL:
+                rows = [
+                    s
+                    for s in rows
+                    if user.organization_id
+                    in m.Collaboration.get(s.collaboration_id).organization_ids()
+                ]
+            return _paginate(req, rows)
+        body = sch.load(sch.StudyInput(), req.json)
+        collab = _get_or_404(m.Collaboration, body["collaboration_id"])
+        _check(
+            pm.allowed(
+                user, "study", Operation.CREATE, collaboration_id=collab.id
+            )
+        )
+        study = m.Study(name=body["name"], collaboration_id=collab.id).save()
+        for oid in body["organization_ids"]:
+            if oid not in collab.organization_ids():
+                raise HTTPError(400, f"organization {oid} not in collaboration")
+            study.add_organization(_get_or_404(m.Organization, oid))
+        return study.to_dict(), 201
+
+    @app.route("/api/study/<int:id>", methods=("GET", "DELETE"))
+    def study_one(req: Request, id: int):
+        user = _require_user(srv, req)
+        study = _get_or_404(m.Study, id)
+        if req.method == "GET":
+            _check(
+                pm.allowed(
+                    user, "study", Operation.VIEW,
+                    collaboration_id=study.collaboration_id,
+                )
+            )
+            return study.to_dict()
+        _check(
+            pm.allowed(
+                user, "study", Operation.DELETE,
+                collaboration_id=study.collaboration_id,
+            )
+        )
+        study.delete()
+        return {}, 204
+
+    # ---------------------------------------------------------------- nodes
+    @app.route("/api/node", methods=("GET", "POST"))
+    def nodes(req: Request):
+        kind, principal = _identity(srv, req)
+        if req.method == "GET":
+            rows = m.Node.list()
+            if kind == "user":
+                scope = pm.user_scope(principal, "node", Operation.VIEW)
+                _check(scope is not None)
+                if scope == Scope.ORGANIZATION:
+                    rows = [
+                        n
+                        for n in rows
+                        if n.organization_id == principal.organization_id
+                    ]
+                elif scope == Scope.COLLABORATION:
+                    rows = [
+                        n
+                        for n in rows
+                        if principal.organization_id
+                        in m.Collaboration.get(n.collaboration_id).organization_ids()
+                    ]
+            elif kind == "node":
+                rows = [
+                    n
+                    for n in rows
+                    if n.collaboration_id == principal.collaboration_id
+                ]
+            else:
+                raise HTTPError(403)
+            return _paginate(req, rows)
+        user = _require_user(srv, req)
+        body = sch.load(sch.NodeInput(), req.json)
+        org_id = body["organization_id"] or user.organization_id
+        collab = _get_or_404(m.Collaboration, body["collaboration_id"])
+        if org_id not in collab.organization_ids():
+            raise HTTPError(400, "organization is not in the collaboration")
+        _check(pm.allowed(user, "node", Operation.CREATE, organization_id=org_id))
+        if _node_for_org(collab.id, org_id) is not None:
+            raise HTTPError(409, "node already exists for this org+collaboration")
+        api_key = m.Node.generate_api_key()
+        node = m.Node(
+            name=body["name"]
+            or f"{m.Organization.get(org_id).name} {collab.name} node",
+            organization_id=org_id,
+            collaboration_id=collab.id,
+            station_index=body["station_index"],
+            status="offline",
+        )
+        node.set_api_key(api_key)
+        node.save()
+        # the api key is returned exactly once, at creation
+        return {**node.to_dict(), "api_key": api_key}, 201
+
+    @app.route("/api/node/<int:id>", methods=("GET", "PATCH", "DELETE"))
+    def node_one(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        node = _get_or_404(m.Node, id)
+        if req.method == "GET":
+            if kind == "user":
+                _check(
+                    pm.allowed(
+                        principal, "node", Operation.VIEW,
+                        organization_id=node.organization_id,
+                        collaboration_id=node.collaboration_id,
+                    )
+                )
+            return node.to_dict()
+        if kind == "node":
+            # a node may PATCH its own status (online/offline heartbeat) —
+            # nothing else
+            _check(req.method == "PATCH" and principal.id == node.id)
+            status = (req.json or {}).get("status")
+            if status in ("online", "offline"):
+                _set_node_status(srv, node, status)
+            return node.to_dict()
+        user = _require_user(srv, req)
+        if req.method == "DELETE":
+            _check(
+                pm.allowed(
+                    user, "node", Operation.DELETE,
+                    organization_id=node.organization_id,
+                )
+            )
+            node.delete()
+            return {}, 204
+        _check(
+            pm.allowed(
+                user, "node", Operation.EDIT,
+                organization_id=node.organization_id,
+            )
+        )
+        name = (req.json or {}).get("name")
+        if name:
+            node.name = name
+            node.save()
+        return node.to_dict()
+
+    # ---------------------------------------------------------------- tasks
+    @app.route("/api/task", methods=("GET", "POST"))
+    def tasks(req: Request):
+        kind, principal = _identity(srv, req)
+        if req.method == "GET":
+            if kind == "user":
+                scope = pm.user_scope(principal, "task", Operation.VIEW)
+                _check(scope is not None)
+                rows = m.Task.list()
+                if scope != Scope.GLOBAL:
+                    visible_collabs = {
+                        c.id
+                        for c in m.Collaboration.list()
+                        if principal.organization_id in c.organization_ids()
+                    }
+                    rows = [
+                        t
+                        for t in rows
+                        if t.collaboration_id in visible_collabs
+                        or t.init_user_id == principal.id
+                    ]
+            elif kind == "node":
+                rows = m.Task.list(collaboration_id=principal.collaboration_id)
+            else:
+                rows = m.Task.list(
+                    collaboration_id=_container_task(principal).collaboration_id
+                )
+            return _paginate(req, rows)
+        return _create_task(srv, req)
+
+    @app.route("/api/task/<int:id>", methods=("GET", "DELETE"))
+    def task_one(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        task = _get_or_404(m.Task, id)
+        if req.method == "GET":
+            if kind == "user":
+                _check(
+                    pm.allowed(
+                        principal, "task", Operation.VIEW,
+                        collaboration_id=task.collaboration_id,
+                        owner_id=task.init_user_id,
+                    )
+                )
+            elif kind == "node":
+                _check(task.collaboration_id == principal.collaboration_id)
+            return task.to_dict()
+        user = _require_user(srv, req)
+        _check(
+            pm.allowed(
+                user, "task", Operation.DELETE,
+                collaboration_id=task.collaboration_id,
+                owner_id=task.init_user_id,
+            )
+        )
+        for run in task.runs():
+            run.delete()
+        task.delete()
+        return {}, 204
+
+    @app.route("/api/task/<int:id>/run", methods=("GET",))
+    def task_runs(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        task = _get_or_404(m.Task, id)
+        if kind == "user":
+            _check(
+                pm.allowed(
+                    principal, "run", Operation.VIEW,
+                    collaboration_id=task.collaboration_id,
+                    owner_id=task.init_user_id,
+                )
+            )
+        runs = task.runs()
+        if kind == "node":
+            # same policy as GET /api/run: a node sees only its own org's
+            # runs (others' inputs/results are not its business)
+            _check(task.collaboration_id == principal.collaboration_id)
+            runs = [
+                r for r in runs if r.organization_id == principal.organization_id
+            ]
+        elif kind == "container":
+            _check(
+                task.collaboration_id
+                == _container_task(principal).collaboration_id
+            )
+        return _paginate(req, runs)
+
+    @app.route("/api/kill/task", methods=("POST",))
+    def kill_task(req: Request):
+        user = _require_user(srv, req)
+        task_id = (req.json or {}).get("task_id")
+        if not task_id:
+            raise HTTPError(400, "task_id required")
+        task = _get_or_404(m.Task, task_id)
+        _check(
+            pm.allowed(
+                user, "task", Operation.EDIT,
+                collaboration_id=task.collaboration_id,
+                owner_id=task.init_user_id,
+            )
+        )
+        killed = []
+        for run in task.runs():
+            if run.status not in (
+                TaskStatus.COMPLETED.value,
+                TaskStatus.FAILED.value,
+                TaskStatus.CRASHED.value,
+            ):
+                run.status = TaskStatus.KILLED.value
+                run.finished_at = time.time()
+                run.save()
+                killed.append(run.id)
+                node = _node_for_org(task.collaboration_id, run.organization_id)
+                if node:
+                    srv.hub.emit(
+                        ev.KILL_TASK,
+                        {"task_id": task.id, "run_id": run.id},
+                        room=ev.node_room(node.id),
+                    )
+        return {"killed_runs": killed}
+
+    # ----------------------------------------------------------------- runs
+    @app.route("/api/run", methods=("GET",))
+    def runs(req: Request):
+        kind, principal = _identity(srv, req)
+        task_id = req.int_arg("task_id")
+        where: dict[str, Any] = {}
+        if task_id is not None:
+            where["task_id"] = task_id
+        rows = m.TaskRun.list(**where)
+        if kind == "user":
+            scope = pm.user_scope(principal, "run", Operation.VIEW)
+            _check(scope is not None)
+            if scope != Scope.GLOBAL:
+                visible = {
+                    c.id
+                    for c in m.Collaboration.list()
+                    if principal.organization_id in c.organization_ids()
+                }
+                rows = [
+                    r
+                    for r in rows
+                    if m.Task.get(r.task_id).collaboration_id in visible
+                ]
+        elif kind == "node":
+            rows = [r for r in rows if r.organization_id == principal.organization_id]
+        else:  # container: runs of its own task tree only
+            own_collab = _container_task(principal).collaboration_id
+            rows = [
+                r
+                for r in rows
+                if m.Task.get(r.task_id).collaboration_id == own_collab
+            ]
+        return _paginate(req, rows)
+
+    @app.route("/api/run/<int:id>", methods=("GET", "PATCH"))
+    def run_one(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        run = _get_or_404(m.TaskRun, id)
+        task = m.Task.get(run.task_id)
+        if req.method == "GET":
+            if kind == "user":
+                _check(
+                    pm.allowed(
+                        principal, "run", Operation.VIEW,
+                        collaboration_id=task.collaboration_id,
+                        owner_id=task.init_user_id,
+                    )
+                )
+            elif kind == "node":
+                _check(run.organization_id == principal.organization_id)
+            else:
+                _check(
+                    task.collaboration_id
+                    == _container_task(principal).collaboration_id
+                )
+            return run.to_dict()
+        # PATCH: only the executing node updates status/result
+        node = _require_node(srv, req)
+        _check(
+            run.organization_id == node.organization_id
+            and task.collaboration_id == node.collaboration_id
+        )
+        body = sch.load(sch.RunPatch(), req.json)
+        for field in ("status", "result", "log", "started_at", "finished_at"):
+            if body[field] is not None:
+                setattr(run, field, body[field])
+        if body["status"] and run.node_id is None:
+            run.node_id = node.id
+        run.save()
+        if body["status"]:
+            srv.hub.emit(
+                ev.STATUS_UPDATE,
+                {
+                    "task_id": task.id,
+                    "run_id": run.id,
+                    "status": run.status,
+                    "organization_id": run.organization_id,
+                    "task_status": task.status(),
+                },
+                room=ev.collaboration_room(task.collaboration_id),
+            )
+        return run.to_dict()
+
+    # ------------------------------------------------------------ rbac views
+    @app.route("/api/role", methods=("GET", "POST"))
+    def roles(req: Request):
+        user = _require_user(srv, req)
+        if req.method == "GET":
+            _check(pm.user_scope(user, "role", Operation.VIEW) is not None)
+            return _paginate(req, m.Role.list())
+        body = sch.load(sch.RoleInput(), req.json)
+        org_id = body["organization_id"]
+        _check(
+            pm.allowed(user, "role", Operation.CREATE, organization_id=org_id)
+            if org_id
+            else pm.user_scope(user, "role", Operation.CREATE) == Scope.GLOBAL
+        )
+        # may only grant rules the grantor holds (reference rule)
+        own = user.rule_ids()
+        for rid in body["rules"]:
+            if rid not in own:
+                raise HTTPError(403, f"cannot grant rule {rid} you do not have")
+        role = m.Role(
+            name=body["name"],
+            description=body["description"],
+            organization_id=org_id,
+        ).save()
+        for rid in body["rules"]:
+            role.add_rule(_get_or_404(m.Rule, rid))
+        return role.to_dict(), 201
+
+    @app.route("/api/role/<int:id>", methods=("GET", "DELETE"))
+    def role_one(req: Request, id: int):
+        user = _require_user(srv, req)
+        role = _get_or_404(m.Role, id)
+        if req.method == "GET":
+            _check(pm.user_scope(user, "role", Operation.VIEW) is not None)
+            return role.to_dict()
+        _check(
+            pm.allowed(
+                user, "role", Operation.DELETE,
+                organization_id=role.organization_id,
+            )
+            if role.organization_id
+            else pm.user_scope(user, "role", Operation.DELETE) == Scope.GLOBAL
+        )
+        role.delete()
+        return {}, 204
+
+    @app.route("/api/rule", methods=("GET",))
+    def rules(req: Request):
+        _require_user(srv, req)
+        return _paginate(req, m.Rule.list())
+
+    # ---------------------------------------------------------------- ports
+    @app.route("/api/port", methods=("GET", "POST"))
+    def ports(req: Request):
+        kind, principal = _identity(srv, req)
+        if req.method == "GET":
+            run_id = req.int_arg("run_id")
+            where = {"run_id": run_id} if run_id is not None else {}
+            rows = m.Port.list(**where)
+            # scope to collaborations the principal can see (port VIEW rule
+            # for users; own collaboration for nodes/containers)
+            if kind == "user":
+                scope = pm.user_scope(principal, "port", Operation.VIEW)
+                _check(scope is not None)
+                if scope != Scope.GLOBAL:
+                    visible = {
+                        c.id
+                        for c in m.Collaboration.list()
+                        if principal.organization_id in c.organization_ids()
+                    }
+                    rows = [
+                        p
+                        for p in rows
+                        if m.Task.get(
+                            m.TaskRun.get(p.run_id).task_id
+                        ).collaboration_id
+                        in visible
+                    ]
+            else:
+                own_collab = (
+                    principal.collaboration_id
+                    if kind == "node"
+                    else _container_task(principal).collaboration_id
+                )
+                rows = [
+                    p
+                    for p in rows
+                    if m.Task.get(
+                        m.TaskRun.get(p.run_id).task_id
+                    ).collaboration_id
+                    == own_collab
+                ]
+            return _paginate(req, rows)
+        node = _require_node(srv, req)
+        body = sch.load(sch.PortInput(), req.json)
+        run = _get_or_404(m.TaskRun, body["run_id"])
+        _check(run.organization_id == node.organization_id)
+        port = m.Port(**body).save()
+        return port.to_dict(), 201
+
+    # --------------------------------------------------------------- events
+    @app.route("/api/event", methods=("GET",))
+    def events_fetch(req: Request):
+        """Cursor catch-up (reference: socket reconnect re-sync)."""
+        kind, principal = _identity(srv, req)
+        since = req.int_arg("since", 0)
+        rooms = _rooms_for(kind, principal)
+        return {
+            "cursor": srv.hub.cursor,
+            "data": [e.to_dict() for e in srv.hub.fetch(since, rooms)],
+        }
+
+    @app.route("/api/ping", methods=("POST",))
+    def ping(req: Request):
+        node = _require_node(srv, req)
+        _set_node_status(srv, node, "online", quiet=True)
+        return {"pong": time.time()}
+
+
+# ------------------------------------------------------------- task creation
+
+
+def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
+    kind, principal = _identity(srv, req)
+    body = sch.load(sch.TaskInput(), req.json)
+    collab = m.Collaboration.get(body["collaboration_id"])
+    if collab is None:
+        raise HTTPError(404, "collaboration not found")
+
+    parent_id = None
+    job_id = None
+    if kind == "user":
+        _check(
+            srv.pm.allowed(
+                principal, "task", Operation.CREATE, collaboration_id=collab.id
+            )
+        )
+        init_org_id = principal.organization_id
+        init_user_id = principal.id
+    elif kind == "container":
+        # a running algorithm creates subtasks within its own task tree
+        parent = _container_task(principal)
+        if parent is None or parent.collaboration_id != collab.id:
+            raise HTTPError(403, "subtask outside parent collaboration")
+        if parent.image != body["image"]:
+            raise HTTPError(403, "subtask must use the parent's algorithm")
+        parent_id = parent.id
+        job_id = parent.job_id
+        init_org_id = principal["organization_id"]
+        init_user_id = parent.init_user_id
+    else:
+        raise HTTPError(403, "nodes cannot create tasks")
+
+    if srv.algorithm_policy is not None and not srv.algorithm_policy(body["image"]):
+        raise HTTPError(403, f"algorithm {body['image']!r} not allowed by store policy")
+
+    member_ids = collab.organization_ids()
+    study_id = body["study_id"]
+    if study_id is not None:
+        study = m.Study.get(study_id)
+        if study is None or study.collaboration_id != collab.id:
+            raise HTTPError(400, "study not in collaboration")
+        member_ids = study.organization_ids()
+
+    org_specs = body["organizations"]
+    for spec in org_specs:
+        if "id" not in spec:
+            raise HTTPError(400, 'each organization entry needs an "id"')
+        if int(spec["id"]) not in member_ids:
+            raise HTTPError(
+                400, f"organization {spec['id']} not in collaboration/study"
+            )
+
+    task = m.Task(
+        name=body["name"],
+        description=body["description"],
+        image=body["image"],
+        method=body["method"],
+        collaboration_id=collab.id,
+        study_id=study_id,
+        parent_id=parent_id,
+        init_org_id=init_org_id,
+        init_user_id=init_user_id,
+        databases=body["databases"] or [{"label": "default"}],
+    ).save()
+    if job_id is None:
+        job_id = task.id  # a root task starts its own job group
+    task.job_id = job_id
+    task.save()
+
+    method = body["method"]
+    for spec in org_specs:
+        org_id = int(spec["id"])
+        node = _node_for_org(collab.id, org_id)
+        run = m.TaskRun(
+            task_id=task.id,
+            organization_id=org_id,
+            node_id=node.id if node else None,
+            status=TaskStatus.PENDING.value,
+            input=spec.get("input", ""),
+            assigned_at=time.time(),
+        ).save()
+        if node:
+            srv.hub.emit(
+                ev.TASK_CREATED,
+                {
+                    "task_id": task.id,
+                    "run_id": run.id,
+                    "method": method,
+                    "image": task.image,
+                    "organization_id": org_id,
+                },
+                room=ev.node_room(node.id),
+            )
+    srv.hub.emit(
+        ev.TASK_CREATED,
+        {"task_id": task.id, "image": task.image},
+        room=ev.collaboration_room(collab.id),
+    )
+    return task.to_dict(), 201
+
+
+# ------------------------------------------------------------------- helpers
+
+
+def _rooms_for(kind: str, principal: Any) -> list[str]:
+    if kind == "user":
+        return [
+            ev.collaboration_room(c.id)
+            for c in m.Collaboration.list()
+            if principal.organization_id in c.organization_ids()
+        ]
+    if kind == "node":
+        return [
+            ev.node_room(principal.id),
+            ev.collaboration_room(principal.collaboration_id),
+        ]
+    # container: its node's collaboration room
+    task = _container_task(principal)
+    return [ev.collaboration_room(task.collaboration_id)]
+
+
+def _set_node_status(
+    srv: "ServerApp", node: m.Node, status: str, quiet: bool = False
+) -> None:
+    changed = node.status != status
+    node.status = status
+    node.last_seen_at = time.time()
+    node.save()
+    if changed and not quiet:
+        srv.hub.emit(
+            ev.NODE_ONLINE if status == "online" else ev.NODE_OFFLINE,
+            {"node_id": node.id, "organization_id": node.organization_id},
+            room=ev.collaboration_room(node.collaboration_id),
+        )

@@ -1,0 +1,317 @@
+"""Minimal WSGI web framework for the control-plane services.
+
+Parity: the reference's servers are Flask apps (SURVEY.md §2 items 1, 3);
+Flask is not in this image, so this module supplies the slice of it the
+control plane needs: routing with typed path params, JSON request/response,
+auth hooks, error handling, a threaded dev server, and an in-process test
+client (Flask's `app.test_client()` equivalent — SURVEY.md §4 test strategy).
+"""
+from __future__ import annotations
+
+import io
+import json
+import re
+import threading
+import traceback
+from typing import Any, Callable
+from urllib.parse import parse_qs
+from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+from vantage6_tpu.common.log import setup_logging
+
+log = setup_logging("vantage6_tpu/web")
+
+
+_UNPARSED = object()
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, msg: str = ""):
+        super().__init__(msg)
+        self.status = status
+        self.msg = msg or {
+            400: "bad request",
+            401: "unauthorized",
+            403: "forbidden",
+            404: "not found",
+            409: "conflict",
+        }.get(status, "error")
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, list[str]],
+        headers: dict[str, str],
+        body: bytes,
+    ):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.identity: dict[str, Any] | None = None  # set by auth middleware
+        self._json: Any = _UNPARSED
+
+    @property
+    def json(self) -> Any:
+        if self._json is _UNPARSED:
+            if not self.body:
+                self._json = {}
+            else:
+                try:
+                    self._json = json.loads(self.body)
+                except json.JSONDecodeError:
+                    raise HTTPError(400, "invalid JSON body") from None
+        return self._json
+
+    def arg(self, name: str, default: str | None = None) -> str | None:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def int_arg(self, name: str, default: int | None = None) -> int | None:
+        v = self.arg(name)
+        if v is None:
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise HTTPError(400, f"query param {name!r} must be an int") from None
+
+    @property
+    def bearer_token(self) -> str | None:
+        h = self.headers.get("authorization", "")
+        return h[7:] if h.lower().startswith("bearer ") else None
+
+    @property
+    def page(self) -> int:
+        return max(1, self.int_arg("page", 1))
+
+    @property
+    def per_page(self) -> int:
+        return min(250, max(1, self.int_arg("per_page", 50)))
+
+
+class Response:
+    def __init__(
+        self,
+        data: Any = None,
+        status: int = 200,
+        headers: dict[str, str] | None = None,
+    ):
+        self.status = status
+        self.headers = headers or {}
+        if isinstance(data, (bytes, str)):
+            self.body = data.encode() if isinstance(data, str) else data
+            self.headers.setdefault("Content-Type", "text/plain")
+        else:
+            self.body = json.dumps(data if data is not None else {}).encode()
+            self.headers.setdefault("Content-Type", "application/json")
+
+
+_PARAM_RE = re.compile(r"<(?:(int|str):)?(\w+)>")
+_STATUS_TEXT = {
+    200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
+    401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 409: "Conflict", 500: "Internal Server Error",
+}
+
+Handler = Callable[..., Any]
+
+
+class App:
+    """Route registry + WSGI callable."""
+
+    def __init__(self, name: str = "app"):
+        self.name = name
+        # (regex, {method: handler})
+        self._routes: list[tuple[re.Pattern[str], dict[str, Handler]]] = []
+        self._auth_hook: Callable[[Request], None] | None = None
+
+    def route(self, pattern: str, methods: tuple[str, ...] = ("GET",)):
+        regex = self._compile(pattern)
+        def deco(fn: Handler) -> Handler:
+            for existing, table in self._routes:
+                if existing.pattern == regex.pattern:
+                    for m in methods:
+                        table[m] = fn
+                    return fn
+            self._routes.append((regex, {m: fn for m in methods}))
+            return fn
+        return deco
+
+    @staticmethod
+    def _compile(pattern: str) -> re.Pattern[str]:
+        out = []
+        pos = 0
+        for m in _PARAM_RE.finditer(pattern):
+            out.append(re.escape(pattern[pos : m.start()]))
+            typ = m.group(1) or "str"
+            name = m.group(2)
+            out.append(
+                f"(?P<{name}>\\d+)" if typ == "int" else f"(?P<{name}>[^/]+)"
+            )
+            pos = m.end()
+        out.append(re.escape(pattern[pos:]))
+        return re.compile("^" + "".join(out) + "$")
+
+    def set_auth_hook(self, hook: Callable[[Request], None]) -> None:
+        """Runs before every handler; sets request.identity or raises 401."""
+        self._auth_hook = hook
+
+    # ---------------------------------------------------------------- serve
+    def handle(self, request: Request) -> Response:
+        for regex, table in self._routes:
+            m = regex.match(request.path)
+            if not m:
+                continue
+            handler = table.get(request.method)
+            if handler is None:
+                return Response({"msg": "method not allowed"}, 405)
+            kwargs = {
+                k: int(v) if v.isdigit() else v
+                for k, v in m.groupdict().items()
+            }
+            try:
+                if self._auth_hook is not None:
+                    self._auth_hook(request)
+                out = handler(request, **kwargs)
+            except HTTPError as e:
+                return Response({"msg": e.msg}, e.status)
+            except Exception:
+                log.error(
+                    "500 on %s %s\n%s",
+                    request.method,
+                    request.path,
+                    traceback.format_exc(limit=8),
+                )
+                return Response({"msg": "internal server error"}, 500)
+            if isinstance(out, Response):
+                return out
+            if isinstance(out, tuple):
+                return Response(out[0], out[1])
+            return Response(out)
+        return Response({"msg": "not found"}, 404)
+
+    def __call__(self, environ: dict[str, Any], start_response: Callable):
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        body = environ["wsgi.input"].read(length) if length else b""
+        headers = {
+            k[5:].replace("_", "-").lower(): v
+            for k, v in environ.items()
+            if k.startswith("HTTP_")
+        }
+        if environ.get("CONTENT_TYPE"):
+            headers["content-type"] = environ["CONTENT_TYPE"]
+        request = Request(
+            method=environ["REQUEST_METHOD"],
+            path=environ.get("PATH_INFO", "/"),
+            query=parse_qs(environ.get("QUERY_STRING", "")),
+            headers=headers,
+            body=body,
+        )
+        resp = self.handle(request)
+        status_line = f"{resp.status} {_STATUS_TEXT.get(resp.status, 'Status')}"
+        start_response(status_line, list(resp.headers.items()))
+        return [resp.body]
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+
+class AppServer:
+    """Threaded HTTP server wrapper with background start/stop (used by the
+    node daemon's proxy and by `v6t server start`)."""
+
+    def __init__(self, app: App, host: str = "127.0.0.1", port: int = 0):
+        self._server = make_server(
+            host, port, app, handler_class=_QuietHandler
+        )
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start_background(self) -> "AppServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class TestClient:
+    """In-process client calling the WSGI app directly (no sockets)."""
+
+    def __init__(self, app: App):
+        self.app = app
+        self.token: str | None = None
+
+    def open(
+        self,
+        method: str,
+        path: str,
+        json_body: Any = None,
+        headers: dict[str, str] | None = None,
+        token: str | None = None,
+    ) -> "TestResponse":
+        query: dict[str, list[str]] = {}
+        if "?" in path:
+            path, _, qs = path.partition("?")
+            query = parse_qs(qs)
+        hdrs = {k.lower(): v for k, v in (headers or {}).items()}
+        tok = token or self.token
+        if tok:
+            hdrs.setdefault("authorization", f"Bearer {tok}")
+        body = b""
+        if json_body is not None:
+            body = json.dumps(json_body).encode()
+            hdrs.setdefault("content-type", "application/json")
+        req = Request(method, path, query, hdrs, body)
+        resp = self.app.handle(req)
+        return TestResponse(resp)
+
+    def get(self, path: str, **kw: Any) -> "TestResponse":
+        return self.open("GET", path, **kw)
+
+    def post(self, path: str, json_body: Any = None, **kw: Any) -> "TestResponse":
+        return self.open("POST", path, json_body, **kw)
+
+    def patch(self, path: str, json_body: Any = None, **kw: Any) -> "TestResponse":
+        return self.open("PATCH", path, json_body, **kw)
+
+    def delete(self, path: str, **kw: Any) -> "TestResponse":
+        return self.open("DELETE", path, **kw)
+
+
+class TestResponse:
+    def __init__(self, resp: Response):
+        self.status = resp.status
+        self.body = resp.body
+        self.headers = resp.headers
+
+    @property
+    def json(self) -> Any:
+        return json.loads(self.body) if self.body else None
+
+    def __repr__(self) -> str:
+        return f"<TestResponse {self.status} {self.body[:200]!r}>"
