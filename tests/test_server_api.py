@@ -266,6 +266,30 @@ class TestTaskLifecycle:
         evs = c.get("/api/event?since=0").json["data"]
         assert any(e["name"] == "kill-task" for e in evs)
 
+    def test_terminal_status_is_immutable(self, srv, seeded):
+        """A node finishing late must not overwrite KILLED (409)."""
+        task = self._make_task(seeded)
+        seeded["client"].post("/api/kill/task", {"task_id": task["id"]})
+        c, node = node_login(srv, seeded["api_keys"][0])
+        all_runs = seeded["client"].get(f"/api/run?task_id={task['id']}").json["data"]
+        mine = next(
+            r for r in all_runs
+            if r["organization"]["id"] == node["organization"]["id"]
+        )
+        r = c.patch(
+            f"/api/run/{mine['id']}", {"status": "completed", "result": "late"}
+        )
+        assert r.status == 409
+        got = seeded["client"].get(f"/api/run/{mine['id']}").json
+        assert got["status"] == "killed by user" and got["result"] != "late"
+
+    def test_run_status_filter(self, srv, seeded):
+        self._make_task(seeded)
+        c = seeded["client"]
+        pending = c.get("/api/run?status=pending").json["data"]
+        assert pending and all(r["status"] == "pending" for r in pending)
+        assert c.get("/api/run?status=completed").json["data"] == []
+
     def test_container_token_and_subtask(self, srv, seeded):
         task = self._make_task(seeded)
         nc, node = node_login(srv, seeded["api_keys"][0])

@@ -1,0 +1,277 @@
+"""UserClient: the researcher-facing SDK.
+
+Parity: vantage6-client `UserClient` (SURVEY.md §2 item 16) — subclients per
+entity (`.task`, `.run`, `.organization`, `.collaboration`, `.node`,
+`.user`, `.role`, `.rule`, `.study`), JWT auth with optional MFA,
+`wait_for_results`, and client-side end-to-end encryption: task inputs are
+encrypted per destination organization's public key; results are decrypted
+with the researcher's own private key.
+"""
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any
+
+from vantage6_tpu.common.encryption import CryptorBase, DummyCryptor, RSACryptor
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.common.rest import RestError, RestSession
+from vantage6_tpu.common.serialization import deserialize, serialize
+
+log = setup_logging("vantage6_tpu/client")
+
+# public alias: callers catch ClientError
+ClientError = RestError
+
+
+class UserClient:
+    """``UserClient("http://localhost", 7601)`` or ``UserClient(url)``."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int | None = None,
+        path: str = "",
+        verbose: bool = False,
+    ):
+        base = host if port is None else f"{host}:{port}"
+        self.base_url = base.rstrip("/") + path
+        self.verbose = verbose
+        self._access_token: str | None = None
+        self._refresh_token: str | None = None
+        self.whoami: dict[str, Any] | None = None
+        self.cryptor: CryptorBase = DummyCryptor()
+        self._encryption_configured = False
+        self._rest = RestSession(
+            self.base_url,
+            token_getter=lambda: self._access_token,
+            refresh=self._refresh,
+        )
+
+        self.task = TaskSubClient(self)
+        self.run = RunSubClient(self)
+        self.result = self.run  # reference alias (Run né Result)
+        self.organization = SubClient(self, "organization")
+        self.collaboration = SubClient(self, "collaboration")
+        self.node = SubClient(self, "node")
+        self.user = SubClient(self, "user")
+        self.role = SubClient(self, "role")
+        self.rule = SubClient(self, "rule")
+        self.study = SubClient(self, "study")
+        self.util = UtilSubClient(self)
+
+    # ------------------------------------------------------------------ http
+    def request(
+        self,
+        method: str,
+        endpoint: str,
+        json_body: Any = None,
+        params: dict[str, Any] | None = None,
+    ) -> Any:
+        return self._rest.request(method, endpoint, json_body, params)
+
+    def paginate(
+        self, endpoint: str, params: dict[str, Any] | None = None
+    ) -> list[dict[str, Any]]:
+        return self._rest.paginate(endpoint, params)
+
+    def _refresh(self) -> bool:
+        if not self._refresh_token:
+            return False
+        try:
+            data = RestSession(self.base_url).request(
+                "POST",
+                "token/refresh",
+                {"refresh_token": self._refresh_token},
+            )
+        except RestError:
+            self._access_token = None
+            return False
+        self._access_token = data["access_token"]
+        self._refresh_token = data.get("refresh_token", self._refresh_token)
+        return True
+
+    # ------------------------------------------------------------------ auth
+    def authenticate(
+        self, username: str, password: str, mfa_code: str | None = None
+    ) -> dict[str, Any]:
+        data = self.request(
+            "POST",
+            "token/user",
+            {"username": username, "password": password, "mfa_code": mfa_code},
+        )
+        self._access_token = data["access_token"]
+        self._refresh_token = data["refresh_token"]
+        self.whoami = data["user"]
+        return data["user"]
+
+    # ------------------------------------------------------------ encryption
+    def setup_encryption(self, private_key: str | Path | None) -> None:
+        """Enable E2E crypto (None -> explicit opt-out, DummyCryptor).
+
+        Registers our public key at our organization if it differs
+        (reference does the same on node start / client setup).
+        """
+        self._encryption_configured = True
+        if private_key is None:
+            self.cryptor = DummyCryptor()
+            return
+        self.cryptor = RSACryptor(private_key)
+        if self.whoami:
+            org_id = self.whoami["organization"]["id"]
+            org = self.organization.get(org_id)
+            if org.get("public_key") != self.cryptor.public_key_str:
+                self.request(
+                    "PATCH",
+                    f"organization/{org_id}",
+                    {"public_key": self.cryptor.public_key_str},
+                )
+
+    # --------------------------------------------------------------- results
+    def wait_for_results(
+        self, task_id: int, interval: float = 0.5, timeout: float = 300.0
+    ) -> list[Any]:
+        """Poll until the task finishes; return decrypted, deserialized
+        results (reference: UserClient.wait_for_results)."""
+        from vantage6_tpu.common.enums import TaskStatus
+
+        deadline = time.time() + timeout
+        while True:
+            task = self.request("GET", f"task/{task_id}")
+            status = TaskStatus(task["status"])
+            if status.is_finished:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"task {task_id} still {status.value} after {timeout}s"
+                )
+            time.sleep(interval)
+        if status.has_failed:
+            runs = self.paginate(f"task/{task_id}/run")
+            logs = {r["organization"]["id"]: r["log"] for r in runs}
+            raise ClientError(500, f"task {task_id} {status.value}: {logs}")
+        runs = self.paginate(f"task/{task_id}/run")
+        out = []
+        for run in sorted(runs, key=lambda r: r["id"]):
+            blob = run.get("result")
+            if not blob:
+                out.append(None)
+                continue
+            out.append(deserialize(self.cryptor.decrypt_str_to_bytes(blob)))
+        return out
+
+
+class SubClient:
+    """Generic CRUD subclient (`client.organization.list()` etc.)."""
+
+    def __init__(self, parent: UserClient, resource: str):
+        self.parent = parent
+        self.resource = resource
+
+    def list(self, **params: Any) -> list[dict[str, Any]]:
+        """All rows (drains every page; pass page/per_page to get one)."""
+        if "page" in params:
+            return self.parent.request(
+                "GET", self.resource, params=params
+            )["data"]
+        return self.parent.paginate(self.resource, params)
+
+    def get(self, id_: int) -> dict[str, Any]:
+        return self.parent.request("GET", f"{self.resource}/{id_}")
+
+    def create(self, **fields: Any) -> dict[str, Any]:
+        return self.parent.request("POST", self.resource, fields)
+
+    def update(self, id_: int, **fields: Any) -> dict[str, Any]:
+        return self.parent.request("PATCH", f"{self.resource}/{id_}", fields)
+
+    def delete(self, id_: int) -> None:
+        self.parent.request("DELETE", f"{self.resource}/{id_}")
+
+
+class TaskSubClient(SubClient):
+    def __init__(self, parent: UserClient):
+        super().__init__(parent, "task")
+
+    def create(
+        self,
+        collaboration: int,
+        organizations: list[int],
+        name: str = "task",
+        image: str = "",
+        description: str = "",
+        input_: dict[str, Any] | None = None,
+        databases: list[dict[str, Any]] | None = None,
+        study: int | None = None,
+    ) -> dict[str, Any]:
+        """Create a task; `input_` is the reference wire shape
+        ``{"method", "args", "kwargs"}``, serialized then encrypted per
+        destination organization's public key when E2E crypto is on."""
+        input_ = input_ or {}
+        blob = serialize(input_)
+        org_specs = []
+        # the COLLABORATION decides whether payloads are encrypted (the
+        # reference refuses mismatches at submit time, not at the node)
+        collab = self.parent.collaboration.get(collaboration)
+        encrypting = bool(collab.get("encrypted"))
+        if encrypting and isinstance(self.parent.cryptor, DummyCryptor):
+            raise ClientError(
+                400,
+                f"collaboration {collaboration} is encrypted: call "
+                "setup_encryption(<private key path>) before creating tasks",
+            )
+        # an unencrypted collaboration always rides plain base64, even when
+        # the researcher holds a key (nodes there have no cryptor)
+        cryptor = self.parent.cryptor if encrypting else DummyCryptor()
+        for org_id in organizations:
+            if encrypting:
+                org = self.parent.organization.get(org_id)
+                pubkey = org.get("public_key")
+                if not pubkey:
+                    raise ClientError(
+                        400,
+                        f"organization {org_id} has no public key registered; "
+                        "cannot E2E-encrypt the task input for it",
+                    )
+            else:
+                pubkey = ""
+            org_specs.append(
+                {"id": org_id, "input": cryptor.encrypt_bytes_to_str(blob, pubkey)}
+            )
+        body = {
+            "name": name,
+            "description": description,
+            "image": image,
+            "method": input_.get("method", ""),
+            "collaboration_id": collaboration,
+            "organizations": org_specs,
+            "databases": databases or [],
+        }
+        if study is not None:
+            body["study_id"] = study
+        return self.parent.request("POST", "task", body)
+
+    def kill(self, task_id: int) -> dict[str, Any]:
+        return self.parent.request("POST", "kill/task", {"task_id": task_id})
+
+
+class RunSubClient(SubClient):
+    def __init__(self, parent: UserClient):
+        super().__init__(parent, "run")
+
+    def from_task(self, task_id: int) -> list[dict[str, Any]]:
+        return self.parent.paginate(f"task/{task_id}/run")
+
+
+class UtilSubClient:
+    def __init__(self, parent: UserClient):
+        self.parent = parent
+
+    def health(self) -> dict[str, Any]:
+        return self.parent.request("GET", "health")
+
+    def version(self) -> dict[str, Any]:
+        return self.parent.request("GET", "version")
+
+    def events(self, since: int = 0) -> dict[str, Any]:
+        return self.parent.request("GET", "event", params={"since": since})

@@ -1,0 +1,131 @@
+"""RestAlgorithmClient — the in-container SDK over HTTP.
+
+Parity: vantage6-algorithm-tools AlgorithmClient (SURVEY.md §2 item 17) in
+its *real* deployment shape: a containerized algorithm talks to its node's
+proxy server with the container JWT from TOKEN_FILE; the proxy relays to the
+control plane and handles org-key encryption. Method surface matches the
+in-process `AlgorithmClient` so algorithm code is identical on-pod and
+containerized (the reference's central fns run unchanged too).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from vantage6_tpu.common.rest import RestSession
+from vantage6_tpu.common.serialization import deserialize, serialize
+
+
+class RestAlgorithmClient:
+    def __init__(self, url: str, token_file: str = "", token: str = ""):
+        self.base_url = url.rstrip("/")
+        if not token and token_file:
+            with open(token_file) as f:
+                token = f.read().strip()
+        self.token = token
+        self._rest = RestSession(self.base_url, token_getter=lambda: self.token)
+        self.task = _TaskSub(self)
+        self.result = _ResultSub(self)
+        self.run = _RunSub(self)
+        self.organization = _OrgSub(self)
+
+    # ------------------------------------------------------------------ http
+    def request(
+        self,
+        method: str,
+        endpoint: str,
+        json_body: Any = None,
+        params: dict[str, Any] | None = None,
+    ) -> Any:
+        return self._rest.request(method, endpoint, json_body, params)
+
+    def paginate(
+        self, endpoint: str, params: dict[str, Any] | None = None
+    ) -> list[dict[str, Any]]:
+        return self._rest.paginate(endpoint, params)
+
+    # --------------------------------------------------------------- results
+    def wait_for_results(
+        self, task_id: int, interval: float = 1.0, timeout: float = 600.0
+    ) -> list[Any]:
+        from vantage6_tpu.common.enums import TaskStatus
+
+        deadline = time.time() + timeout
+        while True:
+            task = self.request("GET", f"task/{task_id}")
+            status = TaskStatus(task["status"])
+            if status.is_finished:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(f"task {task_id} timed out")
+            time.sleep(interval)
+        if status.has_failed:
+            raise RuntimeError(f"subtask {task_id} {status.value}")
+        runs = self.paginate(f"task/{task_id}/run")
+        out = []
+        for run in sorted(runs, key=lambda r: r["id"]):
+            blob = run.get("result")
+            # the proxy has already decrypted: blob is base64 of the
+            # serialized payload
+            out.append(deserialize(_unb64(blob)) if blob else None)
+        return out
+
+
+def _unb64(data: str) -> bytes:
+    import base64
+
+    return base64.b64decode(data)
+
+
+class _TaskSub:
+    def __init__(self, parent: RestAlgorithmClient):
+        self.parent = parent
+
+    def create(
+        self,
+        input_: dict[str, Any],
+        organizations: list[int],
+        name: str = "subtask",
+        **kw: Any,
+    ) -> dict[str, Any]:
+        """POST to the node proxy, which encrypts the input per org and
+        fills in image/collaboration from the container's context."""
+        import base64
+
+        return self.parent.request(
+            "POST",
+            "task",
+            {
+                "name": name,
+                "organizations": list(organizations),
+                "input": base64.b64encode(serialize(input_)).decode(),
+                "databases": kw.get("databases", []),
+            },
+        )
+
+    def get(self, task_id: int) -> dict[str, Any]:
+        return self.parent.request("GET", f"task/{task_id}")
+
+
+class _ResultSub:
+    def __init__(self, parent: RestAlgorithmClient):
+        self.parent = parent
+
+    def get(self, task_id: int) -> list[Any]:
+        return self.parent.wait_for_results(task_id)
+
+
+class _RunSub:
+    def __init__(self, parent: RestAlgorithmClient):
+        self.parent = parent
+
+    def from_task(self, task_id: int) -> list[dict[str, Any]]:
+        return self.parent.paginate(f"task/{task_id}/run")
+
+
+class _OrgSub:
+    def __init__(self, parent: RestAlgorithmClient):
+        self.parent = parent
+
+    def list(self) -> list[dict[str, Any]]:
+        return self.parent.request("GET", "organization")["data"]

@@ -1,0 +1,91 @@
+"""Shared REST session: bearer auth, error mapping, refresh, pagination.
+
+One HTTP wrapper for every client in the stack (UserClient, NodeDaemon,
+RestAlgorithmClient) so wire behavior — bearer header, JSON-or-empty bodies,
+>=400 error mapping, 401 refresh retry, page draining — lives in one place.
+(The node proxy is a *relay*, not a client: it forwards foreign tokens
+verbatim and keeps its own thin forwarding code.)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import requests
+
+
+class RestError(RuntimeError):
+    """Server returned an error status."""
+
+    def __init__(self, status: int, msg: str):
+        super().__init__(f"HTTP {status}: {msg}")
+        self.status = status
+        self.msg = msg
+
+
+class RestSession:
+    """``request()`` + ``paginate()`` against one base URL.
+
+    ``refresh`` (optional) is called on a 401; returning True retries the
+    request once with whatever new token ``token_getter`` now yields.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        token_getter: Callable[[], str | None] = lambda: None,
+        refresh: Callable[[], bool] | None = None,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self._token_getter = token_getter
+        self._refresh = refresh
+        self._session = requests.Session()
+
+    def request(
+        self,
+        method: str,
+        endpoint: str,
+        json_body: Any = None,
+        params: dict[str, Any] | None = None,
+        _retry: bool = True,
+    ) -> Any:
+        headers = {}
+        token = self._token_getter()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        resp = self._session.request(
+            method,
+            f"{self.base_url}/api/{endpoint.lstrip('/')}",
+            json=json_body,
+            params=params,
+            headers=headers,
+        )
+        if (
+            resp.status_code == 401
+            and _retry
+            and self._refresh is not None
+            and self._refresh()
+        ):
+            return self.request(method, endpoint, json_body, params, False)
+        body = resp.json() if resp.content else {}
+        if resp.status_code >= 400:
+            raise RestError(resp.status_code, body.get("msg", resp.text))
+        return body
+
+    def paginate(
+        self, endpoint: str, params: dict[str, Any] | None = None
+    ) -> list[dict[str, Any]]:
+        """Drain ALL pages of a `{"data": [...], "pagination": {...}}`
+        endpoint — silent first-page truncation loses runs/nodes."""
+        params = dict(params or {})
+        params.setdefault("per_page", 250)
+        out: list[dict[str, Any]] = []
+        page = 1
+        while True:
+            params["page"] = page
+            body = self.request("GET", endpoint, params=params)
+            data = body.get("data", [])
+            out.extend(data)
+            total = body.get("pagination", {}).get("total", len(out))
+            if len(out) >= total or not data:
+                return out
+            page += 1

@@ -1,0 +1,3 @@
+"""Data-station agent (parity: vantage6-node, SURVEY.md §2 items 10-15)."""
+from vantage6_tpu.node.daemon import NodeDaemon  # noqa: F401
+from vantage6_tpu.node.runner import TaskRunner  # noqa: F401

@@ -1,0 +1,362 @@
+"""The node daemon — one data station's agent.
+
+Parity: vantage6-node `Node` (SURVEY.md §2 item 10, call stack §3.3):
+authenticate with the api_key → set up encryption + proxy + runner →
+go online → sync missed work → listen for tasks → execute → report.
+The reference listens on a SocketIO socket; here the daemon drains the
+server's room-scoped event cursor (push via websockets arrives with the
+same payloads — the cursor IS the reconnect path in both designs).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Any
+
+from vantage6_tpu.common.encryption import CryptorBase, DummyCryptor, RSACryptor
+from vantage6_tpu.common.rest import RestError, RestSession
+from vantage6_tpu.common.enums import TaskStatus
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.common.serialization import deserialize
+from vantage6_tpu.node.proxy import NodeProxy
+from vantage6_tpu.node.runner import (
+    PolicyViolation,
+    RunSpec,
+    TaskRunner,
+    UnknownAlgorithm,
+)
+
+log = setup_logging("vantage6_tpu/node")
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        api_url: str,
+        api_key: str,
+        algorithms: dict[str, str] | None = None,
+        databases: list[dict[str, Any]] | None = None,
+        policies: dict[str, Any] | None = None,
+        private_key: str | Path | None = None,
+        mode: str = "sandbox",
+        poll_interval: float = 0.25,
+        name: str = "",
+        max_concurrent_runs: int = 4,
+    ):
+        self.api_url = api_url.rstrip("/")
+        self.api_key = api_key
+        self.poll_interval = poll_interval
+        self._access_token: str | None = None
+        self._refresh_token: str | None = None
+        self._rest = RestSession(
+            self.api_url,
+            token_getter=lambda: self._access_token,
+            refresh=self._refresh,
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._cursor = 0
+        self._killed: set[int] = set()
+        # Runs execute in workers, NOT the listen thread: a central run
+        # blocks on its own subtasks, which may land on THIS node — the
+        # reference gets the same concurrency from parallel containers.
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_concurrent_runs, thread_name_prefix="v6t-run"
+        )
+        self._claimed: set[int] = set()
+        self._claim_lock = threading.Lock()
+
+        # authenticate (reference: Node.__init__ authenticates first)
+        data = self._post_raw(
+            "token/node", {"api_key": api_key}, auth=False
+        )
+        self._access_token = data["access_token"]
+        self._refresh_token = data["refresh_token"]
+        self.info = data["node"]
+        self.id: int = self.info["id"]
+        self.organization_id: int = self.info["organization"]["id"]
+        self.collaboration_id: int = self.info["collaboration"]["id"]
+        self.name = name or self.info["name"]
+
+        collab = self.request("GET", f"collaboration/{self.collaboration_id}")
+        self.encrypted: bool = bool(collab.get("encrypted"))
+
+        # encryption: the node holds its organization's private key
+        if self.encrypted:
+            if private_key is None:
+                raise ValueError(
+                    "collaboration is encrypted: the node needs a "
+                    "private_key path"
+                )
+            self.cryptor: CryptorBase = RSACryptor(private_key)
+            self._register_public_key()
+        else:
+            self.cryptor = DummyCryptor()
+
+        self.runner = TaskRunner(
+            algorithms=algorithms,
+            databases=databases,
+            policies=policies,
+            mode=mode,
+        )
+        self.proxy = NodeProxy(
+            server_url=self.api_url,
+            cryptor=self.cryptor,
+            collaboration_id=self.collaboration_id,
+            encrypted=self.encrypted,
+        )
+        self._proxy_server = None
+
+    @classmethod
+    def from_context(cls, ctx: Any, **overrides: Any) -> "NodeDaemon":
+        """Build from a NodeContext (YAML instance config)."""
+        cfg = ctx.config
+        return cls(
+            api_url=cfg["api_url"],
+            api_key=cfg["api_key"],
+            algorithms=cfg.get("algorithms", {}) or {},
+            databases=cfg.get("databases", []) or [],
+            policies=cfg.get("policies", {}) or {},
+            private_key=(
+                str(ctx.private_key_path)
+                if (cfg.get("encryption", {}) or {}).get("enabled")
+                else None
+            ),
+            mode=(cfg.get("runner", {}) or {}).get("mode", "sandbox"),
+            name=ctx.name,
+            **overrides,
+        )
+
+    # ------------------------------------------------------------------ http
+    def _post_raw(self, endpoint: str, body: Any, auth: bool = True) -> Any:
+        session = self._rest if auth else RestSession(self.api_url)
+        return session.request("POST", endpoint, body)
+
+    def request(
+        self,
+        method: str,
+        endpoint: str,
+        json_body: Any = None,
+        params: dict[str, Any] | None = None,
+    ) -> Any:
+        return self._rest.request(method, endpoint, json_body, params)
+
+    def _refresh(self) -> bool:
+        if not self._refresh_token:
+            return False
+        try:
+            data = RestSession(self.api_url).request(
+                "POST", "token/refresh",
+                {"refresh_token": self._refresh_token},
+            )
+        except RestError:
+            return False
+        self._access_token = data["access_token"]
+        self._refresh_token = data.get("refresh_token", self._refresh_token)
+        return True
+
+    def _register_public_key(self) -> None:
+        org = self.request("GET", f"organization/{self.organization_id}")
+        pub = self.cryptor.public_key_str  # type: ignore[union-attr]
+        if org.get("public_key") != pub:
+            self.request(
+                "PATCH",
+                f"organization/{self.organization_id}",
+                {"public_key": pub},
+            )
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, background: bool = True) -> "NodeDaemon":
+        self._proxy_server = self.proxy.serve()
+        self.request("PATCH", f"node/{self.id}", {"status": "online"})
+        self._cursor = self.request("GET", "event", params={"since": 0})[
+            "cursor"
+        ]
+        self._sync_missed_runs()
+        if background:
+            self._thread = threading.Thread(target=self._listen, daemon=True)
+            self._thread.start()
+            return self
+        self._listen()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self._pool.shutdown(wait=True, cancel_futures=True)
+        try:
+            self.request("PATCH", f"node/{self.id}", {"status": "offline"})
+        except Exception:
+            pass
+        if self._proxy_server:
+            self._proxy_server.stop()
+
+    # ---------------------------------------------------------------- listen
+    def _listen(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self.request(
+                    "GET", "event", params={"since": self._cursor}
+                )
+            except Exception as e:
+                log.warning("event poll failed: %s", e)
+                self._stop.wait(self.poll_interval * 4)
+                continue
+            self._cursor = max(self._cursor, batch["cursor"])
+            for event in batch["data"]:
+                self._handle(event)
+            self._stop.wait(self.poll_interval)
+
+    def _handle(self, event: dict[str, Any]) -> None:
+        name, data = event["name"], event["data"]
+        if name == "task-created" and data.get("run_id"):
+            if data.get("organization_id") == self.organization_id:
+                self._submit(data["run_id"])
+        elif name == "kill-task":
+            self._killed.add(data.get("run_id"))
+
+    def _submit(self, run_id: int) -> None:
+        with self._claim_lock:
+            if run_id in self._claimed:
+                return
+            self._claimed.add(run_id)
+        self._pool.submit(self._execute_logged, run_id)
+
+    def _execute_logged(self, run_id: int) -> None:
+        try:
+            self._execute(run_id)
+        except Exception:
+            log.error("run %s worker crashed:\n%s", run_id,
+                      traceback.format_exc(limit=8))
+
+    def _sync_missed_runs(self) -> None:
+        """Reference: sync_task_queue_with_server — execute runs queued
+        while the node was offline. Server-side status filter + full page
+        drain: pending work must never hide behind page 1 of history."""
+        page = 1
+        while True:
+            body = self.request(
+                "GET",
+                "run",
+                params={
+                    "status": TaskStatus.PENDING.value,
+                    "per_page": 250,
+                    "page": page,
+                },
+            )
+            for run in body["data"]:
+                self._submit(run["id"])
+            total = body.get("pagination", {}).get("total", 0)
+            if page * 250 >= total or not body["data"]:
+                return
+            page += 1
+
+    # --------------------------------------------------------------- execute
+    def _execute(self, run_id: int) -> None:
+        try:
+            run = self.request("GET", f"run/{run_id}")
+        except Exception as e:
+            log.error("cannot fetch run %s: %s", run_id, e)
+            return
+        if run["status"] != TaskStatus.PENDING.value or run_id in self._killed:
+            return
+        task = self.request("GET", f"task/{run['task']['id']}")
+
+        def patch(**kw: Any) -> None:
+            try:
+                self.request("PATCH", f"run/{run_id}", kw)
+            except RuntimeError as e:
+                # 409 = the server already moved the run to a terminal state
+                # (killed mid-execution); the server's word is final
+                if "409" in str(e):
+                    log.info("run %s already terminal at server: %s", run_id, e)
+                else:
+                    raise
+        try:
+            payload = deserialize(
+                self.cryptor.decrypt_str_to_bytes(run["input"] or "")
+            )
+        except Exception:
+            patch(
+                status=TaskStatus.FAILED.value,
+                log="cannot decrypt/deserialize input "
+                + traceback.format_exc(limit=2),
+                finished_at=time.time(),
+            )
+            return
+        patch(status=TaskStatus.ACTIVE.value, started_at=time.time())
+        try:
+            # everything after ACTIVE must record its failure, or the run
+            # sticks ACTIVE forever while the researcher polls
+            token = self.request(
+                "POST",
+                "token/container",
+                {"task_id": task["id"], "image": task["image"]},
+            )["container_token"]
+            spec = RunSpec(
+                run_id=run_id,
+                task_id=task["id"],
+                image=task["image"],
+                method=payload.get("method", task["method"]),
+                input_payload=payload,
+                databases=task.get("databases") or [],
+                token=token,
+                server_url=(
+                    self._proxy_server.url if self._proxy_server else ""
+                ),
+                metadata={
+                    "node_id": self.id,
+                    "organization": str(self.organization_id),
+                    "collaboration": str(self.collaboration_id),
+                    "init_user": str(task.get("init_user", {}).get("id", "")),
+                },
+            )
+            result = self.runner.run(spec)
+        except PolicyViolation as e:
+            patch(
+                status=TaskStatus.NOT_ALLOWED.value,
+                log=str(e),
+                finished_at=time.time(),
+            )
+            return
+        except UnknownAlgorithm as e:
+            patch(
+                status=TaskStatus.NO_IMAGE.value,
+                log=str(e),
+                finished_at=time.time(),
+            )
+            return
+        except Exception:
+            patch(
+                status=TaskStatus.CRASHED.value,
+                log=traceback.format_exc(limit=8),
+                finished_at=time.time(),
+            )
+            return
+        if run_id in self._killed:
+            # killed while executing: the server already holds KILLED; do
+            # not deliver results the user cancelled
+            log.info("run %s was killed mid-execution; dropping result", run_id)
+            return
+        # result goes back encrypted toward the INITIATING organization
+        from vantage6_tpu.common.serialization import serialize
+
+        init_org = task.get("init_org", {}).get("id")
+        pubkey = ""
+        if self.encrypted and init_org is not None:
+            org = self.request("GET", f"organization/{init_org}")
+            pubkey = org.get("public_key") or ""
+        blob = self.cryptor.encrypt_bytes_to_str(serialize(result), pubkey)
+        patch(
+            status=TaskStatus.COMPLETED.value,
+            result=blob,
+            finished_at=time.time(),
+        )
+
+    # --------------------------------------------------------------- health
+    def ping(self) -> None:
+        self.request("POST", "ping")

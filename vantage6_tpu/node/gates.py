@@ -1,0 +1,106 @@
+"""Network gates: VPN, outbound whitelist, SSH tunnels.
+
+Parity: vantage6-node's optional networking containers (SURVEY.md §2 items
+13-15) — WireGuard VPN for cross-station algorithm traffic, a squid proxy
+whitelisting outbound HTTP, and SSH tunnels to internal services. On a TPU
+pod none of these transports exist (cross-station traffic is ICI; stations
+are sub-meshes, not firewalled hospitals), so these managers keep the
+reference's *configuration and policy surface* — parse/validate config,
+answer reachability questions, register ports — while the transport itself
+is the mesh. Each manager states its stance via `supported`/`reason`.
+"""
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any
+from urllib.parse import urlparse
+
+from vantage6_tpu.common.log import setup_logging
+
+log = setup_logging("vantage6_tpu/node.gates")
+
+
+@dataclass
+class VPNManager:
+    """Reference: WireGuard client container + server-registered ports.
+
+    Here "VPN connectivity" between algorithm runs maps to device-mesh
+    neighbor exchange; the manager still tracks per-run exposed ports (the
+    server's `Port` entity) so iterative/MPC algorithms can discover peers.
+    """
+
+    enabled: bool = False
+    subnet: str = "10.76.0.0/16"
+    supported: bool = False
+    reason: str = (
+        "cross-station traffic rides the device mesh (ICI), not WireGuard; "
+        "port registration is kept for peer discovery parity"
+    )
+
+    def setup(self) -> bool:
+        if self.enabled:
+            log.warning("vpn requested: %s", self.reason)
+        return False
+
+    def exposed_ports(self, algorithm_env: dict[str, Any]) -> list[int]:
+        """Ports an algorithm declares (reference: image EXPOSE labels)."""
+        raw = str(algorithm_env.get("ports", "") or "")
+        return [int(p) for p in raw.split(",") if p.strip().isdigit()]
+
+
+@dataclass
+class OutboundWhitelist:
+    """Reference: squid proxy restricting algorithm egress (item 14).
+
+    The policy *decision* survives: `allows(url)` is consulted before any
+    host-side fetch an algorithm requests (data loading from sql/sparql
+    URIs, artifact downloads).
+    """
+
+    enabled: bool = False
+    domains: list[str] = field(default_factory=list)
+    ips: list[str] = field(default_factory=list)
+    ports: list[int] = field(default_factory=list)
+
+    def allows(self, url: str) -> bool:
+        if not self.enabled:
+            return True
+        parsed = urlparse(url if "//" in url else f"//{url}")
+        host = parsed.hostname or ""
+        port = parsed.port
+        host_ok = any(
+            fnmatch.fnmatch(host, pat) for pat in (self.domains + self.ips)
+        )
+        port_ok = port is None or not self.ports or port in self.ports
+        return host_ok and port_ok
+
+
+@dataclass
+class SSHTunnelManager:
+    """Reference: ssh tunnels from node to whitelisted internal hosts
+    (item 15). Tracked as *named endpoints* algorithms may address; actual
+    tunneling is out of scope on-pod (data is mounted/loaded directly)."""
+
+    tunnels: dict[str, dict[str, Any]] = field(default_factory=dict)
+    supported: bool = False
+    reason: str = "station data is mounted locally; no remote DB hop exists"
+
+    @classmethod
+    def from_config(cls, cfg: list[dict[str, Any]] | None) -> "SSHTunnelManager":
+        mgr = cls()
+        for t in cfg or []:
+            name = t.get("hostname") or t.get("name")
+            if not name:
+                raise ValueError("ssh tunnel config needs a hostname/name")
+            mgr.tunnels[name] = dict(t)
+        if mgr.tunnels:
+            log.warning("ssh tunnels configured: %s", mgr.reason)
+        return mgr
+
+    def endpoint(self, name: str) -> dict[str, Any]:
+        if name not in self.tunnels:
+            raise KeyError(
+                f"no tunnel {name!r} (configured: {sorted(self.tunnels)})"
+            )
+        return self.tunnels[name]

@@ -1,0 +1,181 @@
+"""The node's proxy server — algorithm containers' window to the world.
+
+Parity: vantage6-node `proxy_server.py` (SURVEY.md §2 item 12). Algorithm
+containers never reach the control plane directly: they talk to this little
+server on the node-local network, which (a) relays requests with the
+container's JWT, (b) encrypts subtask inputs per destination organization's
+public key, and (c) decrypts incoming results with the node's (org's)
+private key — so containers never touch key material.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+import requests as _requests
+
+from vantage6_tpu.common.encryption import CryptorBase
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.server.web import App, AppServer, HTTPError, Request
+
+log = setup_logging("vantage6_tpu/node.proxy")
+
+
+class NodeProxy:
+    """Builds the proxy App for one node."""
+
+    def __init__(
+        self,
+        server_url: str,
+        cryptor: CryptorBase,
+        collaboration_id: int,
+        encrypted: bool,
+    ):
+        self.server_url = server_url.rstrip("/")
+        self.cryptor = cryptor
+        self.collaboration_id = collaboration_id
+        self.encrypted = encrypted
+        self._org_pubkeys: dict[int, str] = {}
+        self.app = App("v6t-node-proxy")
+        self._register()
+
+    # ------------------------------------------------------------- helpers
+    def _forward(
+        self,
+        req: Request,
+        method: str,
+        endpoint: str,
+        json_body: Any = None,
+    ) -> Any:
+        token = req.bearer_token
+        if not token:
+            raise HTTPError(401, "container token required")
+        resp = _requests.request(
+            method,
+            f"{self.server_url}/api/{endpoint.lstrip('/')}",
+            json=json_body,
+            params={k: v[0] for k, v in req.query.items()},
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        body = resp.json() if resp.content else {}
+        if resp.status_code >= 400:
+            raise HTTPError(resp.status_code, body.get("msg", "upstream error"))
+        return body
+
+    def _pubkey(self, req: Request, org_id: int) -> str:
+        if org_id not in self._org_pubkeys:
+            org = self._forward(req, "GET", f"organization/{org_id}")
+            key = org.get("public_key") or ""
+            if not key:
+                raise HTTPError(
+                    400,
+                    f"organization {org_id} has no public key; cannot "
+                    "encrypt the subtask input",
+                )
+            self._org_pubkeys[org_id] = key
+        return self._org_pubkeys[org_id]
+
+    def _decrypt_result(self, blob: str | None) -> str | None:
+        """Encrypted-toward-our-org blob -> base64(plaintext serialized)."""
+        if not blob:
+            return blob
+        try:
+            plain = self.cryptor.decrypt_str_to_bytes(blob)
+        except Exception:
+            # result was encrypted toward a different org (not our task
+            # tree) — pass the ciphertext through rather than failing
+            return blob
+        return base64.b64encode(plain).decode("ascii")
+
+    # -------------------------------------------------------------- routes
+    def _register(self) -> None:
+        app = self.app
+
+        @app.route("/api/task", methods=("POST",))
+        def create_task(req: Request):
+            body = req.json or {}
+            orgs = body.get("organizations") or []
+            if not orgs:
+                raise HTTPError(400, "organizations required")
+            try:
+                input_plain = base64.b64decode(body.get("input", ""))
+            except Exception:
+                raise HTTPError(400, "input must be base64") from None
+            org_specs = []
+            for org_id in orgs:
+                wire = self.cryptor.encrypt_bytes_to_str(
+                    input_plain,
+                    self._pubkey(req, int(org_id)) if self.encrypted else "",
+                )
+                org_specs.append({"id": int(org_id), "input": wire})
+            import json as _json
+
+            method = ""
+            try:
+                method = _json.loads(input_plain).get("method", "")
+            except Exception:
+                pass
+            upstream = {
+                "name": body.get("name", "subtask"),
+                "image": body.get("image", ""),
+                "method": method,
+                "collaboration_id": self.collaboration_id,
+                "organizations": org_specs,
+                "databases": body.get("databases") or [],
+            }
+            # the server derives the true image from the container token's
+            # parent task; containers cannot spoof it (resources._create_task)
+            if not upstream["image"]:
+                task_id = self._token_task_id(req)
+                parent = self._forward(req, "GET", f"task/{task_id}")
+                upstream["image"] = parent["image"]
+            return self._forward(req, "POST", "task", upstream), 201
+
+        @app.route("/api/task/<int:id>", methods=("GET",))
+        def get_task(req: Request, id: int):
+            return self._forward(req, "GET", f"task/{id}")
+
+        @app.route("/api/task/<int:id>/run", methods=("GET",))
+        def get_task_runs(req: Request, id: int):
+            body = self._forward(req, "GET", f"task/{id}/run")
+            for run in body.get("data", []):
+                run["result"] = self._decrypt_result(run.get("result"))
+                run.pop("input", None)  # containers never see others' inputs
+            return body
+
+        @app.route("/api/run", methods=("GET",))
+        def get_runs(req: Request):
+            body = self._forward(req, "GET", "run")
+            for run in body.get("data", []):
+                run["result"] = self._decrypt_result(run.get("result"))
+                run.pop("input", None)
+            return body
+
+        @app.route("/api/organization", methods=("GET",))
+        def organizations(req: Request):
+            return self._forward(req, "GET", "organization")
+
+        @app.route("/api/health", methods=("GET",))
+        def health(req: Request):
+            return {"status": "ok", "proxy": True}
+
+    def _token_task_id(self, req: Request) -> int:
+        """Best-effort read of the container token's task id (unverified
+        here — the server re-verifies; the proxy just needs routing info)."""
+        import json as _json
+
+        token = req.bearer_token or ""
+        try:
+            payload = token.split(".")[1]
+            payload += "=" * (-len(payload) % 4)
+            claims = _json.loads(base64.urlsafe_b64decode(payload))
+            return int(claims["sub"]["task_id"])
+        except Exception:
+            raise HTTPError(401, "malformed container token") from None
+
+    # ---------------------------------------------------------------- serve
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> AppServer:
+        server = AppServer(self.app, host, port)
+        server.start_background()
+        log.info("node proxy on %s", server.url)
+        return server

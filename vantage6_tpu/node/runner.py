@@ -1,0 +1,239 @@
+"""TaskRunner — the DockerManager/DockerTaskManager equivalent.
+
+Parity: SURVEY.md §2 item 11. The reference pulls the algorithm image,
+verifies it against node policy, creates a container with data mounts + env
+ABI, and harvests the exit code + OUTPUT_FILE. Here an "image" names a
+registered Python algorithm module (see common.artifact); execution is
+either **inline** (imported module, same process — the on-pod fast path) or
+**sandboxed** (a subprocess speaking the identical env-file ABI that a real
+container would — `wrap_algorithm` on the other side), chosen per node
+config. Policy gates (allowed algorithms, basics) match the reference's.
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from vantage6_tpu.common.artifact import parse_ref
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.common.serialization import deserialize, serialize
+
+log = setup_logging("vantage6_tpu/node.runner")
+
+
+class PolicyViolation(Exception):
+    """Algorithm/image refused by node policy (reference: NOT_ALLOWED)."""
+
+
+class UnknownAlgorithm(Exception):
+    """Image not registered at this node (reference: NO_DOCKER_IMAGE)."""
+
+
+@dataclass
+class RunSpec:
+    """Everything the runner needs for one run."""
+
+    run_id: int
+    task_id: int
+    image: str
+    method: str
+    input_payload: dict[str, Any]  # decrypted {"method","args","kwargs"}
+    databases: list[dict[str, Any]] = field(default_factory=list)
+    token: str = ""  # container token for subtask creation
+    server_url: str = ""  # proxy URL the algorithm should talk to
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        algorithms: dict[str, str] | None = None,
+        databases: list[dict[str, Any]] | None = None,
+        policies: dict[str, Any] | None = None,
+        mode: str = "sandbox",
+        work_dir: str | Path | None = None,
+    ):
+        """``algorithms`` maps image name -> importable module path.
+
+        ``databases`` is the node-config list ({label, type, uri}).
+        ``mode``: "sandbox" (subprocess ABI, default — container parity) or
+        "inline" (same process — fast, used by tests and trusted setups).
+        """
+        self.algorithms = dict(algorithms or {})
+        self.databases = {d["label"]: d for d in (databases or [])}
+        self.policies = dict(policies or {})
+        if mode not in ("sandbox", "inline"):
+            raise ValueError(f"unknown runner mode {mode!r}")
+        self.mode = mode
+        self.work_dir = Path(work_dir or tempfile.mkdtemp(prefix="v6t_node_"))
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+
+    # ---------------------------------------------------------------- policy
+    def check_policy(self, image: str, init_user: str | None = None) -> None:
+        """Reference DockerManager policy gate: allowed algorithms and
+        (optionally) allowed initiating users."""
+        ref = parse_ref(image)  # raises on malformed refs
+        allowed = self.policies.get("allowed_algorithms")
+        if allowed and not any(
+            fnmatch.fnmatch(image, pat) or fnmatch.fnmatch(ref.without_digest, pat)
+            for pat in allowed
+        ):
+            raise PolicyViolation(f"algorithm {image!r} not in allow-list")
+        users = self.policies.get("allowed_users")
+        if users:
+            # configs write ids as ints, the wire carries strings — compare
+            # normalized so [1] and ["1"] behave identically
+            allowed_users = {str(u) for u in users}
+            if init_user is None or str(init_user) not in allowed_users:
+                raise PolicyViolation(
+                    f"user {init_user!r} may not run tasks on this node"
+                )
+
+    def resolve(self, image: str) -> str:
+        module = self.algorithms.get(image) or self.algorithms.get(
+            parse_ref(image).without_digest
+        )
+        if module is None:
+            raise UnknownAlgorithm(f"no algorithm registered for {image!r}")
+        return module
+
+    # ----------------------------------------------------------------- run
+    def run(self, spec: RunSpec) -> Any:
+        """Execute one run; returns the (plaintext) result object.
+
+        Raises PolicyViolation/UnknownAlgorithm for gate failures and
+        RuntimeError (with the log tail) when the algorithm itself crashes.
+        """
+        self.check_policy(spec.image, spec.metadata.get("init_user"))
+        module = self.resolve(spec.image)
+        if self.mode == "inline":
+            return self._run_inline(module, spec)
+        return self._run_sandbox(module, spec)
+
+    # ------------------------------------------------------------ inline
+    def _run_inline(self, module: str, spec: RunSpec) -> Any:
+        import importlib
+
+        from vantage6_tpu.algorithm.context import (
+            AlgorithmEnvironment,
+            RunMetadata,
+            algorithm_environment,
+        )
+        from vantage6_tpu.algorithm.data_loading import load_data
+        from vantage6_tpu.client.rest import RestAlgorithmClient
+        from vantage6_tpu.core.config import DatabaseConfig
+
+        mod = importlib.import_module(module)
+        fn = getattr(mod, spec.method, None)
+        if fn is None:
+            raise UnknownAlgorithm(
+                f"method {spec.method!r} not found in {module}"
+            )
+        frames = [
+            load_data(DatabaseConfig(**self._db_config(d)))
+            for d in (spec.databases or [{"label": "default"}])
+        ]
+        client = (
+            RestAlgorithmClient(spec.server_url, token=spec.token)
+            if spec.server_url
+            else None
+        )
+        env = AlgorithmEnvironment(
+            dataframes=frames,
+            client=client,
+            metadata=RunMetadata(
+                task_id=spec.task_id,
+                run_id=spec.run_id,
+                node_id=spec.metadata.get("node_id"),
+                organization=spec.metadata.get("organization", ""),
+                collaboration=spec.metadata.get("collaboration", ""),
+            ),
+        )
+        args = spec.input_payload.get("args", []) or []
+        kwargs = spec.input_payload.get("kwargs", {}) or {}
+        with algorithm_environment(env):
+            return fn(*args, **kwargs)
+
+    # ----------------------------------------------------------- sandbox
+    def _run_sandbox(self, module: str, spec: RunSpec) -> Any:
+        """Subprocess speaking the container ABI (reference: docker run)."""
+        run_dir = self.work_dir / f"run_{spec.run_id}"
+        run_dir.mkdir(parents=True, exist_ok=True)
+        input_file = run_dir / "input"
+        output_file = run_dir / "output"
+        token_file = run_dir / "token"
+        input_file.write_bytes(serialize(spec.input_payload))
+        token_file.write_text(spec.token)
+
+        env = {
+            **os.environ,
+            "INPUT_FILE": str(input_file),
+            "OUTPUT_FILE": str(output_file),
+            "TOKEN_FILE": str(token_file),
+            "TASK_ID": str(spec.task_id),
+            "RUN_ID": str(spec.run_id),
+            "TEMPORARY_FOLDER": str(run_dir),
+        }
+        if spec.server_url:
+            env["V6T_SERVER_URL"] = spec.server_url
+        labels = [
+            d.get("label", "default")
+            for d in (spec.databases or [{"label": "default"}])
+        ]
+        env["USER_REQUESTED_DATABASE_LABELS"] = ",".join(labels)
+        for label in labels:
+            cfg = self._db_config({"label": label})
+            env[f"DATABASE_{label.upper()}_URI"] = str(cfg.get("uri", ""))
+            env[f"DATABASE_{label.upper()}_TYPE"] = str(cfg.get("type", "csv"))
+        for k, v in spec.metadata.items():
+            if k in ("node_id",):
+                env["NODE_ID"] = str(v)
+            elif k == "organization":
+                env["ORGANIZATION_NAME"] = str(v)
+            elif k == "collaboration":
+                env["COLLABORATION_NAME"] = str(v)
+
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from vantage6_tpu.algorithm.wrap import wrap_algorithm; "
+                f"wrap_algorithm({module!r})",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=self.policies.get("task_timeout", 600),
+        )
+        (run_dir / "log").write_text(proc.stdout + proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"algorithm exited {proc.returncode}:\n"
+                + (proc.stderr or proc.stdout)[-2000:]
+            )
+        if not output_file.exists():
+            raise RuntimeError("algorithm wrote no OUTPUT_FILE")
+        return deserialize(output_file.read_bytes())
+
+    # ----------------------------------------------------------------- util
+    def _db_config(self, requested: dict[str, Any]) -> dict[str, Any]:
+        label = requested.get("label", "default")
+        cfg = self.databases.get(label)
+        if cfg is None:
+            raise KeyError(
+                f"node has no database labeled {label!r} "
+                f"(configured: {sorted(self.databases)})"
+            )
+        return {
+            "label": label,
+            "type": cfg.get("type", "csv"),
+            "uri": cfg.get("uri", ""),
+            "options": cfg.get("options", {}) or {},
+        }

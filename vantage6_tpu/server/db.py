@@ -124,7 +124,7 @@ class Model:
     @classmethod
     def ensure_schema(cls) -> None:
         cols = ", ".join(
-            f"{name} {_TYPES[t]}" for name, t in cls.COLUMNS.items()
+            f'"{name}" {_TYPES[t]}' for name, t in cls.COLUMNS.items()
         )
         cls._db().execute(
             f"CREATE TABLE IF NOT EXISTS {cls.TABLE} "
@@ -140,13 +140,13 @@ class Model:
         for name, t in cls.COLUMNS.items():
             if name not in have:
                 cls._db().execute(
-                    f"ALTER TABLE {cls.TABLE} ADD COLUMN {name} {_TYPES[t]}"
+                    f'ALTER TABLE {cls.TABLE} ADD COLUMN "{name}" {_TYPES[t]}'
                 )
         for name in cls.COLUMNS:
             if name.endswith("_id"):
                 cls._db().execute(
                     f"CREATE INDEX IF NOT EXISTS idx_{cls.TABLE}_{name} "
-                    f"ON {cls.TABLE}({name})"
+                    f'ON {cls.TABLE}("{name}")'
                 )
 
     # ------------------------------------------------------------- marshal
@@ -181,13 +181,13 @@ class Model:
             placeholders = ", ".join("?" for _ in range(len(cols) + 1))
             cur = self._db().execute(
                 f"INSERT INTO {self.TABLE} (created_at"
-                + (", " + ", ".join(cols) if cols else "")
+                + (", " + ", ".join(f'"{c}"' for c in cols) if cols else "")
                 + f") VALUES ({placeholders})",
                 [self.created_at, *vals],
             )
             self.id = cur.lastrowid
         else:
-            sets = ", ".join(f"{c} = ?" for c in cols)
+            sets = ", ".join(f'"{c}" = ?' for c in cols)
             self._db().execute(
                 f"UPDATE {self.TABLE} SET {sets} WHERE id = ?",
                 [*vals, self.id],
@@ -221,9 +221,9 @@ class Model:
             conds = []
             for k, v in where.items():
                 if v is None:
-                    conds.append(f"{k} IS NULL")
+                    conds.append(f'"{k}" IS NULL')
                 else:
-                    conds.append(f"{k} = ?")
+                    conds.append(f'"{k}" = ?')
                     params.append(int(v) if isinstance(v, bool) else v)
             sql += " WHERE " + " AND ".join(conds)
         sql += f" ORDER BY {order}"
@@ -245,9 +245,9 @@ class Model:
             conds = []
             for k, v in where.items():
                 if v is None:
-                    conds.append(f"{k} IS NULL")
+                    conds.append(f'"{k}" IS NULL')
                 else:
-                    conds.append(f"{k} = ?")
+                    conds.append(f'"{k}" = ?')
                     params.append(int(v) if isinstance(v, bool) else v)
             sql += " WHERE " + " AND ".join(conds)
         return int(cls._db().query(sql, params)[0]["n"])

@@ -327,6 +327,32 @@ def register_resources(srv: "ServerApp") -> None:
                         for c in m.Collaboration.list()
                     )
                 )
+            else:
+                # nodes/containers: own org or a fellow collaboration member
+                collab_id = (
+                    principal.collaboration_id
+                    if kind == "node"
+                    else _container_task(principal).collaboration_id
+                )
+                own_org = (
+                    principal.organization_id
+                    if kind == "node"
+                    else principal["organization_id"]
+                )
+                _check(
+                    org.id == own_org
+                    or org.id
+                    in m.Collaboration.get(collab_id).organization_ids()
+                )
+            return org.to_dict()
+        if kind == "node":
+            # a node registers/rotates its OWN organization's public key
+            # (reference: node start uploads the org pubkey) — nothing else
+            _check(principal.organization_id == org.id)
+            body = sch.load(sch.OrganizationPatch(), req.json)
+            if body.get("public_key") is not None:
+                org.public_key = body["public_key"]
+                org.save()
             return org.to_dict()
         user = _require_user(srv, req)
         _check(
@@ -606,6 +632,11 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             elif kind == "node":
                 _check(task.collaboration_id == principal.collaboration_id)
+            else:  # container: its own collaboration only
+                _check(
+                    task.collaboration_id
+                    == _container_task(principal).collaboration_id
+                )
             return task.to_dict()
         user = _require_user(srv, req)
         _check(
@@ -689,6 +720,9 @@ def register_resources(srv: "ServerApp") -> None:
         where: dict[str, Any] = {}
         if task_id is not None:
             where["task_id"] = task_id
+        status = req.arg("status")
+        if status is not None:
+            where["status"] = status
         rows = m.TaskRun.list(**where)
         if kind == "user":
             scope = pm.user_scope(principal, "run", Operation.VIEW)
@@ -744,6 +778,16 @@ def register_resources(srv: "ServerApp") -> None:
             and task.collaboration_id == node.collaboration_id
         )
         body = sch.load(sch.RunPatch(), req.json)
+        if (
+            body["status"]
+            and run.status
+            and TaskStatus(run.status).is_finished
+        ):
+            # terminal states are immutable: a node finishing late must not
+            # overwrite KILLED (or re-open a completed run)
+            raise HTTPError(
+                409, f"run {run.id} already {run.status}; cannot change"
+            )
         for field in ("status", "result", "log", "started_at", "finished_at"):
             if body[field] is not None:
                 setattr(run, field, body[field])
@@ -875,6 +919,17 @@ def register_resources(srv: "ServerApp") -> None:
             "cursor": srv.hub.cursor,
             "data": [e.to_dict() for e in srv.hub.fetch(since, rooms)],
         }
+
+    @app.route("/api/whoami", methods=("GET",))
+    def whoami(req: Request):
+        """Identity introspection (the algorithm store's trust handshake
+        validates a caller's token by asking the caller's server)."""
+        kind, principal = _identity(srv, req)
+        if kind == "user":
+            return {"type": "user", **principal.to_dict()}
+        if kind == "node":
+            return {"type": "node", **principal.to_dict()}
+        return {"type": "container", **principal}
 
     @app.route("/api/ping", methods=("POST",))
     def ping(req: Request):
