@@ -1,0 +1,226 @@
+"""Algorithm store: submit → review → approve workflow + server gate."""
+import pytest
+
+from vantage6_tpu.server.app import ServerApp
+from vantage6_tpu.store.app import StoreApp, store_gate
+from vantage6_tpu.client import UserClient
+
+
+@pytest.fixture()
+def world():
+    """server (real HTTP, for the trust handshake) + store + users."""
+    srv = ServerApp()
+    srv.ensure_root(password="rootpass123")
+    http = srv.serve(port=0, background=True)
+    client = UserClient(http.url)
+    client.authenticate("root", "rootpass123")
+    org = client.organization.create(name="org")
+    # a developer (submits) and a reviewer
+    researcher_role = next(
+        r for r in client.role.list() if r["name"] == "Researcher"
+    )
+    for name in ("dev", "rev"):
+        client.user.create(
+            username=name,
+            password=f"{name}pass12345",
+            organization_id=org["id"],
+            roles=[researcher_role["id"]],
+        )
+    store = StoreApp(reviewers=["rev"], trusted_servers=[http.url])
+    yield {"srv": srv, "http": http, "client": client, "store": store}
+    store.close()
+    http.stop()
+    srv.close()
+
+
+def store_call(world, username, method, path, body=None):
+    c = UserClient(world["http"].url)
+    c.authenticate(username, f"{username}pass12345")
+    sc = world["store"].test_client()
+    return sc.open(
+        method,
+        path,
+        body,
+        headers={"Server-Url": world["http"].url},
+        token=c._access_token,
+    )
+
+
+ALGO = {
+    "name": "federated average",
+    "image": "harbor2.vantage6.ai/algorithms/average:1.0",
+    "description": "column mean without sharing rows",
+    "partitioning": "horizontal",
+    "functions": [
+        {
+            "name": "central_average",
+            "type": "central",
+            "arguments": [{"name": "column", "type": "column"}],
+        },
+        {
+            "name": "partial_average",
+            "type": "federated",
+            "arguments": [{"name": "column", "type": "column"}],
+            "databases": [{"name": "default"}],
+        },
+    ],
+}
+
+
+class TestWorkflow:
+    def test_submit_review_approve(self, world):
+        r = store_call(world, "dev", "POST", "/api/algorithm", ALGO)
+        assert r.status == 201, r
+        alg = r.json
+        assert alg["status"] == "submitted"
+        assert len(alg["functions"]) == 2
+        assert alg["functions"][0]["arguments"][0]["type"] == "column"
+
+        # dev cannot review (not a reviewer); rev can
+        assert (
+            store_call(world, "dev", "POST", f"/api/algorithm/{alg['id']}/review").status
+            == 403
+        )
+        rev = store_call(world, "rev", "POST", f"/api/algorithm/{alg['id']}/review")
+        assert rev.status == 201
+        # algorithm now under review; approve it
+        r2 = store_call(
+            world, "rev", "PATCH", f"/api/review/{rev.json['id']}",
+            {"status": "approved", "comment": "clean"},
+        )
+        assert r2.status == 200
+        sc = world["store"].test_client()
+        got = sc.get(f"/api/algorithm/{alg['id']}").json
+        assert got["status"] == "approved" and got["approved_at"]
+
+    def test_only_assigned_reviewer_decides(self, world):
+        alg = store_call(world, "dev", "POST", "/api/algorithm", ALGO).json
+        rev = store_call(world, "rev", "POST", f"/api/algorithm/{alg['id']}/review").json
+        r = store_call(
+            world, "dev", "PATCH", f"/api/review/{rev['id']}", {"status": "approved"}
+        )
+        assert r.status == 403
+
+    def test_rejection(self, world):
+        alg = store_call(world, "dev", "POST", "/api/algorithm", ALGO).json
+        rev = store_call(world, "rev", "POST", f"/api/algorithm/{alg['id']}/review").json
+        store_call(
+            world, "rev", "PATCH", f"/api/review/{rev['id']}",
+            {"status": "rejected", "comment": "leaks rows"},
+        )
+        got = store_call(world, "dev", "GET", f"/api/algorithm/{alg['id']}")
+        assert got.json["status"] == "rejected"
+        # rejected algorithms are NOT public
+        sc = world["store"].test_client()
+        assert sc.get(f"/api/algorithm/{alg['id']}").status == 401
+
+    def test_decisions_are_final_and_rejection_stands(self, world):
+        alg = store_call(world, "dev", "POST", "/api/algorithm", ALGO).json
+        r1 = store_call(world, "rev", "POST", f"/api/algorithm/{alg['id']}/review").json
+        store_call(world, "rev", "PATCH", f"/api/review/{r1['id']}",
+                   {"status": "rejected"})
+        # cannot re-decide a finished review
+        again = store_call(world, "rev", "PATCH", f"/api/review/{r1['id']}",
+                           {"status": "approved"})
+        assert again.status == 409
+        # a second review's approval does not override the rejection
+        r2 = store_call(world, "rev", "POST", f"/api/algorithm/{alg['id']}/review").json
+        store_call(world, "rev", "PATCH", f"/api/review/{r2['id']}",
+                   {"status": "approved"})
+        got = store_call(world, "dev", "GET", f"/api/algorithm/{alg['id']}")
+        assert got.json["status"] == "rejected"
+
+    def test_invalid_submission_leaves_no_orphans(self, world):
+        bad = dict(ALGO)
+        bad["functions"] = [
+            {"name": "good", "type": "federated"},
+            {"name": "bad", "type": "bogus-type"},
+        ]
+        r = store_call(world, "dev", "POST", "/api/algorithm", bad)
+        assert r.status == 400
+        listing = store_call(world, "dev", "GET", "/api/algorithm")
+        assert listing.json["data"] == []
+
+    def test_unauthenticated_sees_only_approved(self, world):
+        store_call(world, "dev", "POST", "/api/algorithm", ALGO)
+        sc = world["store"].test_client()
+        assert sc.get("/api/algorithm").json["data"] == []
+        assert sc.get("/api/algorithm?status=submitted").status == 401
+
+    def test_untrusted_server_rejected(self, world):
+        c = UserClient(world["http"].url)
+        c.authenticate("dev", "devpass12345")
+        sc = world["store"].test_client()
+        r = sc.open(
+            "POST",
+            "/api/algorithm",
+            ALGO,
+            headers={"Server-Url": "http://evil.example"},
+            token=c._access_token,
+        )
+        assert r.status == 403
+
+    def test_bad_token_rejected(self, world):
+        sc = world["store"].test_client()
+        r = sc.open(
+            "POST",
+            "/api/algorithm",
+            ALGO,
+            headers={"Server-Url": world["http"].url},
+            token="garbage",
+        )
+        assert r.status == 401
+
+
+class TestPolicyGate:
+    def test_allowed_endpoint_and_server_gate(self, world):
+        sc = world["store"].test_client()
+        q = "/api/policy/allowed?image=harbor2.vantage6.ai/algorithms/average:1.0"
+        assert sc.get(q).json["allowed"] is False
+        alg = store_call(world, "dev", "POST", "/api/algorithm", ALGO).json
+        rev = store_call(world, "rev", "POST", f"/api/algorithm/{alg['id']}/review").json
+        store_call(
+            world, "rev", "PATCH", f"/api/review/{rev['id']}", {"status": "approved"}
+        )
+        assert sc.get(q).json["allowed"] is True
+        # digest-pinned request for the same artifact also passes
+        q2 = q + "@sha256:" + "0" * 64
+        assert sc.get(q2).json["allowed"] is True
+        assert sc.get("/api/policy/allowed?image=unknown:9").json["allowed"] is False
+
+    def test_server_task_gate_blocks_unapproved(self, world):
+        """ServerApp.algorithm_policy wired to a live store over HTTP."""
+        store_http = world["store"].serve(port=0, background=True)
+        try:
+            world["srv"].algorithm_policy = store_gate(store_http.url)
+            client = world["client"]
+            org = client.organization.list()[0]
+            collab = client.collaboration.create(
+                name="gated", organization_ids=[org["id"]]
+            )
+            with pytest.raises(Exception, match="not allowed by store"):
+                client.task.create(
+                    collaboration=collab["id"],
+                    organizations=[org["id"]],
+                    image="not-in-store:1.0",
+                    input_={"method": "x"},
+                )
+            # approve an algorithm, then the same image passes the gate
+            alg = store_call(world, "dev", "POST", "/api/algorithm", ALGO).json
+            rev = store_call(
+                world, "rev", "POST", f"/api/algorithm/{alg['id']}/review"
+            ).json
+            store_call(
+                world, "rev", "PATCH", f"/api/review/{rev['id']}",
+                {"status": "approved"},
+            )
+            task = client.task.create(
+                collaboration=collab["id"],
+                organizations=[org["id"]],
+                image="harbor2.vantage6.ai/algorithms/average:1.0",
+                input_={"method": "partial_average"},
+            )
+            assert task["id"]
+        finally:
+            world["srv"].algorithm_policy = None
+            store_http.stop()

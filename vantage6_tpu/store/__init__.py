@@ -1,0 +1,2 @@
+"""Algorithm store (parity: vantage6-algorithm-store, SURVEY.md §2 item 9)."""
+from vantage6_tpu.store.app import StoreApp, store_gate  # noqa: F401

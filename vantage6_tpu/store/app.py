@@ -1,0 +1,331 @@
+"""The algorithm store service.
+
+Parity: vantage6-algorithm-store (SURVEY.md §2 item 9) — a registry of
+*reviewed* algorithms separate from any one server: researchers submit an
+algorithm (image ref + declared functions/arguments), reviewers approve or
+reject it, and control-plane servers consult the store before accepting a
+task for an image (`ServerApp.algorithm_policy` ← `store_gate`).
+
+Trust handshake: the store keeps a list of trusted server URLs; a caller
+presents its server's JWT plus a `Server-Url` header and the store validates
+the token against that server's `/api/whoami` — users never get separate
+store credentials, exactly the reference's model.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import requests as _requests
+
+from vantage6_tpu.common.artifact import parse_ref, same_artifact
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.server.web import App, AppServer, HTTPError, Request, TestClient
+from vantage6_tpu.store import models as sm
+
+log = setup_logging("vantage6_tpu/store")
+
+
+class StoreApp:
+    def __init__(
+        self,
+        uri: str = "sqlite:///:memory:",
+        reviewers: list[str] | None = None,
+        trusted_servers: list[str] | None = None,
+        open_review: bool = False,
+    ):
+        """``reviewers``: usernames allowed to review; ``open_review``
+        additionally lets any authenticated user review (dev mode)."""
+        self.db = sm.init_store(uri)
+        self.reviewers = set(reviewers or [])
+        self.open_review = open_review
+        self._identity_cache: dict[str, tuple[float, dict[str, Any]]] = {}
+        for url in trusted_servers or []:
+            self.trust_server(url)
+        self.app = App("vantage6_tpu-store")
+        self._register()
+
+    def close(self) -> None:
+        self.db.close()
+        sm.StoreModel.db = None
+
+    # ------------------------------------------------------------- trust
+    def trust_server(self, url: str) -> None:
+        url = url.rstrip("/")
+        if sm.TrustedServer.first(url=url) is None:
+            sm.TrustedServer(url=url).save()
+
+    def _authenticate(self, req: Request) -> dict[str, Any]:
+        token = req.bearer_token
+        server_url = (req.headers.get("server-url") or "").rstrip("/")
+        if not token or not server_url:
+            raise HTTPError(401, "bearer token + Server-Url header required")
+        if sm.TrustedServer.first(url=server_url) is None:
+            raise HTTPError(403, f"server {server_url} is not trusted")
+        cache_key = f"{server_url}|{token}"
+        hit = self._identity_cache.get(cache_key)
+        if hit:
+            if time.time() - hit[0] < 60:
+                return hit[1]
+            del self._identity_cache[cache_key]  # stale: evict, re-validate
+        if len(self._identity_cache) >= 1024:
+            # bounded: drop the oldest half rather than leak per-token forever
+            for key, _ in sorted(
+                self._identity_cache.items(), key=lambda kv: kv[1][0]
+            )[:512]:
+                del self._identity_cache[key]
+        try:
+            resp = _requests.get(
+                f"{server_url}/api/whoami",
+                headers={"Authorization": f"Bearer {token}"},
+                timeout=10,
+            )
+        except _requests.RequestException as e:
+            raise HTTPError(502, f"cannot reach {server_url}: {e}") from None
+        if resp.status_code != 200:
+            raise HTTPError(401, "token rejected by its server")
+        who = resp.json()
+        if who.get("type") != "user":
+            raise HTTPError(403, "store actions require a user token")
+        who["server_url"] = server_url
+        self._identity_cache[cache_key] = (time.time(), who)
+        return who
+
+    def _is_reviewer(self, who: dict[str, Any]) -> bool:
+        return self.open_review or who.get("username") in self.reviewers
+
+    @staticmethod
+    def _recompute_status(alg: sm.Algorithm) -> None:
+        """Algorithm status derives from ALL its reviews — a standing
+        rejection is never overridden by a later approval."""
+        statuses = [r.status for r in alg.reviews()]
+        if "rejected" in statuses:
+            alg.status = "rejected"
+        elif "under review" in statuses:
+            alg.status = "under review"
+        elif statuses and all(s == "approved" for s in statuses):
+            alg.status = "approved"
+            alg.approved_at = alg.approved_at or time.time()
+        else:
+            alg.status = "submitted"
+        alg.save()
+
+    # ------------------------------------------------------------- routes
+    def _register(self) -> None:
+        app = self.app
+
+        @app.route("/api/health")
+        def health(req: Request):
+            return {"status": "ok", "store": True}
+
+        @app.route("/api/version")
+        def version(req: Request):
+            from vantage6_tpu import __version__
+
+            return {"version": __version__}
+
+        @app.route("/api/algorithm", methods=("GET", "POST"))
+        def algorithms(req: Request):
+            if req.method == "GET":
+                # the PUBLIC registry is the approved set; browsing other
+                # statuses (submissions under review, rejections) requires a
+                # trusted-server user token
+                status = req.arg("status")
+                if req.bearer_token:
+                    self._authenticate(req)
+                    where: dict[str, Any] = {"status": status} if status else {}
+                else:
+                    if status and status != "approved":
+                        raise HTTPError(
+                            401,
+                            "browsing non-approved algorithms requires a "
+                            "trusted-server token",
+                        )
+                    where = {"status": "approved"}
+                rows = sm.Algorithm.list(**where)
+                image = req.arg("image")
+                if image:
+                    try:
+                        rows = [
+                            a for a in rows if same_artifact(a.image, image)
+                        ]
+                    except ValueError:
+                        raise HTTPError(400, "malformed image ref") from None
+                return {"data": [a.to_dict() for a in rows]}
+            who = self._authenticate(req)
+            body = req.json or {}
+            if not body.get("name") or not body.get("image"):
+                raise HTTPError(400, "algorithm needs name + image")
+            try:
+                parse_ref(body["image"])
+            except ValueError:
+                raise HTTPError(400, "malformed image ref") from None
+            partitioning = body.get("partitioning", "horizontal")
+            if partitioning not in ("horizontal", "vertical"):
+                raise HTTPError(400, "partitioning: horizontal|vertical")
+            # validate EVERYTHING before the first save — a 400 must not
+            # leave a half-built algorithm in the registry
+            for fn in body.get("functions", []) or []:
+                if fn.get("type", "federated") not in sm.Function.TYPES:
+                    raise HTTPError(400, f"bad function type {fn.get('type')}")
+                for arg in fn.get("arguments", []) or []:
+                    if arg.get("type", "string") not in sm.Argument.TYPES:
+                        raise HTTPError(
+                            400, f"bad argument type {arg.get('type')}"
+                        )
+            alg = sm.Algorithm(
+                name=body["name"],
+                image=body["image"],
+                description=body.get("description", ""),
+                partitioning=partitioning,
+                vantage6_version=body.get("vantage6_version", ""),
+                code_url=body.get("code_url", ""),
+                digest=body.get("digest", ""),
+                status="submitted",
+                submitted_by=who["username"],
+            ).save()
+            for fn in body.get("functions", []) or []:
+                f = sm.Function(
+                    algorithm_id=alg.id,
+                    name=fn.get("name", ""),
+                    display_name=fn.get("display_name", fn.get("name", "")),
+                    description=fn.get("description", ""),
+                    type=fn.get("type", "federated"),
+                    databases=fn.get("databases", []) or [],
+                ).save()
+                for arg in fn.get("arguments", []) or []:
+                    sm.Argument(
+                        function_id=f.id,
+                        name=arg.get("name", ""),
+                        display_name=arg.get("display_name", arg.get("name", "")),
+                        description=arg.get("description", ""),
+                        type=arg.get("type", "string"),
+                        has_default="default" in arg,
+                        default=arg.get("default"),
+                    ).save()
+            return alg.to_dict(), 201
+
+        @app.route("/api/algorithm/<int:id>", methods=("GET", "DELETE"))
+        def algorithm_one(req: Request, id: int):
+            alg = sm.Algorithm.get(id)
+            if alg is None:
+                raise HTTPError(404)
+            if req.method == "GET":
+                if alg.status != "approved":
+                    self._authenticate(req)  # non-approved detail needs auth
+                return alg.to_dict()
+            who = self._authenticate(req)
+            if not (
+                self._is_reviewer(who) or who["username"] == alg.submitted_by
+            ):
+                raise HTTPError(403, "only reviewers or the submitter may delete")
+            for f in alg.functions():
+                for a in f.arguments():
+                    a.delete()
+                f.delete()
+            for r in alg.reviews():
+                r.delete()
+            alg.delete()
+            return {}, 204
+
+        @app.route("/api/algorithm/<int:id>/review", methods=("POST",))
+        def start_review(req: Request, id: int):
+            who = self._authenticate(req)
+            alg = sm.Algorithm.get(id)
+            if alg is None:
+                raise HTTPError(404)
+            if not self._is_reviewer(who):
+                raise HTTPError(403, "not a reviewer")
+            if who["username"] == alg.submitted_by and not self.open_review:
+                raise HTTPError(403, "cannot review your own algorithm")
+            review = sm.Review(
+                algorithm_id=alg.id,
+                reviewer=who["username"],
+                status="under review",
+                comment="",
+            ).save()
+            alg.status = "under review"
+            alg.save()
+            return review.to_dict(), 201
+
+        @app.route("/api/review", methods=("GET",))
+        def reviews(req: Request):
+            self._authenticate(req)
+            where: dict[str, Any] = {}
+            if req.int_arg("algorithm_id") is not None:
+                where["algorithm_id"] = req.int_arg("algorithm_id")
+            return {"data": [r.to_dict() for r in sm.Review.list(**where)]}
+
+        @app.route("/api/review/<int:id>", methods=("GET", "PATCH"))
+        def review_one(req: Request, id: int):
+            review = sm.Review.get(id)
+            if review is None:
+                raise HTTPError(404)
+            if req.method == "GET":
+                self._authenticate(req)
+                return review.to_dict()
+            who = self._authenticate(req)
+            if who["username"] != review.reviewer:
+                raise HTTPError(403, "only the assigned reviewer may decide")
+            if review.status != "under review":
+                raise HTTPError(
+                    409, f"review already {review.status}; decisions are final"
+                )
+            body = req.json or {}
+            verdict = body.get("status")
+            if verdict not in ("approved", "rejected"):
+                raise HTTPError(400, "status: approved|rejected")
+            review.status = verdict
+            review.comment = body.get("comment", "")
+            review.finished_at = time.time()
+            review.save()
+            self._recompute_status(sm.Algorithm.get(review.algorithm_id))
+            return review.to_dict()
+
+        @app.route("/api/policy/allowed", methods=("GET",))
+        def policy_allowed(req: Request):
+            """Is this image approved? (servers gate task creation on this)"""
+            image = req.arg("image")
+            if not image:
+                raise HTTPError(400, "image param required")
+            try:
+                for alg in sm.Algorithm.list(status="approved"):
+                    if same_artifact(alg.image, image):
+                        return {"allowed": True, "algorithm_id": alg.id}
+            except ValueError:
+                return {"allowed": False, "reason": "malformed image ref"}
+            return {"allowed": False, "reason": "no approved algorithm"}
+
+    # ---------------------------------------------------------------- serve
+    def test_client(self) -> TestClient:
+        return TestClient(self.app)
+
+    def serve(
+        self, host: str = "127.0.0.1", port: int = 7602, background: bool = False
+    ) -> AppServer:
+        server = AppServer(self.app, host, port)
+        log.info("serving algorithm store on %s", server.url)
+        if background:
+            return server.start_background()
+        server.serve_forever()
+        return server
+
+
+def store_gate(store_url: str) -> Any:
+    """An `algorithm_policy` callable for ServerApp: allow only images the
+    store has approved (fail-closed when the store is unreachable)."""
+    store_url = store_url.rstrip("/")
+
+    def policy(image: str) -> bool:
+        try:
+            resp = _requests.get(
+                f"{store_url}/api/policy/allowed",
+                params={"image": image},
+                timeout=10,
+            )
+            return bool(resp.status_code == 200 and resp.json().get("allowed"))
+        except _requests.RequestException:
+            log.warning("algorithm store unreachable; denying %r", image)
+            return False
+
+    return policy

@@ -1,0 +1,178 @@
+"""Algorithm-store entities.
+
+Parity: vantage6-algorithm-store models (SURVEY.md §2 item 9): `Algorithm`
+(an image plus its declared functions), `Function`/`Argument` (the callable
+surface researchers build task UIs from), `Review` (the submit → review →
+approve workflow), and `TrustedServer` (the store↔server handshake:
+which vantage6 servers' users may talk to this store). Own database —
+its own `Model` subtree with its own binding (see server.db).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from vantage6_tpu.server.db import Database, LinkTable, Model
+
+
+class StoreModel(Model):
+    """Store hierarchy root: own db binding, independent of the server's."""
+
+    db = None
+
+
+class Algorithm(StoreModel):
+    TABLE = "algorithm"
+    COLUMNS = {
+        "name": "str",
+        "image": "str",  # artifact ref (common.artifact grammar)
+        "description": "str",
+        "partitioning": "str",  # horizontal | vertical
+        "vantage6_version": "str",
+        "code_url": "str",
+        "digest": "str",  # content digest pinned at approval
+        "status": "str",  # submitted | under review | approved | rejected
+        "submitted_by": "str",
+        "approved_at": "float",
+    }
+
+    STATUSES = ("submitted", "under review", "approved", "rejected")
+
+    def functions(self) -> list["Function"]:
+        return Function.list(algorithm_id=self.id)
+
+    def reviews(self) -> list["Review"]:
+        return Review.list(algorithm_id=self.id)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "image": self.image,
+            "description": self.description,
+            "partitioning": self.partitioning,
+            "vantage6_version": self.vantage6_version,
+            "code_url": self.code_url,
+            "digest": self.digest,
+            "status": self.status,
+            "submitted_by": self.submitted_by,
+            "approved_at": self.approved_at,
+            "functions": [f.to_dict() for f in self.functions()],
+            "reviews": [r.id for r in self.reviews()],
+        }
+
+
+class Function(StoreModel):
+    TABLE = "function"
+    COLUMNS = {
+        "algorithm_id": "int",
+        "name": "str",
+        "display_name": "str",
+        "description": "str",
+        "type": "str",  # central | federated (reference wording for partial)
+        "databases": "json",  # [{"name": ..., "description": ...}]
+    }
+
+    TYPES = ("central", "federated")
+
+    def arguments(self) -> list["Argument"]:
+        return Argument.list(function_id=self.id)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "display_name": self.display_name,
+            "description": self.description,
+            "type": self.type,
+            "databases": self.databases or [],
+            "arguments": [a.to_dict() for a in self.arguments()],
+        }
+
+
+class Argument(StoreModel):
+    TABLE = "argument"
+    COLUMNS = {
+        "function_id": "int",
+        "name": "str",
+        "display_name": "str",
+        "description": "str",
+        "type": "str",  # string | integer | float | boolean | json | column | organization | organization_list
+        "has_default": "bool",
+        "default": "json",
+    }
+
+    TYPES = (
+        "string",
+        "integer",
+        "float",
+        "boolean",
+        "json",
+        "column",
+        "organization",
+        "organization_list",
+    )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "display_name": self.display_name,
+            "description": self.description,
+            "type": self.type,
+            "has_default": bool(self.has_default),
+            "default": self.default,
+        }
+
+
+class Review(StoreModel):
+    TABLE = "review"
+    COLUMNS = {
+        "algorithm_id": "int",
+        "reviewer": "str",
+        "status": "str",  # under review | approved | rejected
+        "comment": "str",
+        "finished_at": "float",
+    }
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "algorithm": {"id": self.algorithm_id},
+            "reviewer": self.reviewer,
+            "status": self.status,
+            "comment": self.comment,
+            "finished_at": self.finished_at,
+        }
+
+
+class TrustedServer(StoreModel):
+    """A control-plane server whose users may use this store."""
+
+    TABLE = "trusted_server"
+    COLUMNS = {
+        "url": "str",
+    }
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"id": self.id, "url": self.url}
+
+
+ALL_STORE_MODELS: list[type[StoreModel]] = [
+    Algorithm,
+    Function,
+    Argument,
+    Review,
+    TrustedServer,
+]
+
+
+def init_store(uri: str = "sqlite:///:memory:") -> Database:
+    if StoreModel.db is not None:
+        raise RuntimeError(
+            "store models already bound; close and unbind first"
+        )
+    db = Database(uri)
+    StoreModel.db = db
+    for model in ALL_STORE_MODELS:
+        model.ensure_schema()
+    return db
