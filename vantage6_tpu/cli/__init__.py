@@ -1,0 +1,1 @@
+"""Operator CLI (parity: the `v6` CLI, SURVEY.md §2 item 26)."""
