@@ -143,12 +143,22 @@ class TestAlgorithmCreate:
         import subprocess
         import sys
 
+        import os
+
+        child_env = {
+            **os.environ,
+            # the child only needs CPU; letting it init the TPU backend is
+            # slow and hangs outright when the accelerator is busy/wedged
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        }
         proc = subprocess.run(
             [sys.executable, "-m", "pytest", str(pkg / "test_algorithm.py"), "-q"],
             capture_output=True,
             text=True,
             cwd=tmp_path,
             timeout=300,
+            env=child_env,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
 

@@ -1,0 +1,82 @@
+"""Federated sequence-parallel transformer on the fake 8-device pod:
+4 stations x 2 sequence shards; loss decreases; isolation holds."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vantage6_tpu.workloads import fed_transformer as FT
+
+
+@pytest.fixture(scope="module")
+def engine():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake devices")
+    cfg = FT.TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                               max_len=128)
+    return FT.make_engine(n_stations=4, seq_devices=2, cfg=cfg, lr=3e-3)
+
+
+def test_training_reduces_loss(engine):
+    cfg = engine.cfg
+    tokens = FT.make_federated_tokens(4, batch=4, seq_len=64, vocab=cfg.vocab)
+    sharded = engine.shard_tokens(tokens)
+    params, opt_state = engine.init(jax.random.key(0))
+    mask = jnp.ones(4)
+    first = None
+    for step in range(30):
+        params, opt_state, loss = engine.round(params, opt_state, sharded, mask)
+        if first is None:
+            first = float(loss)
+    assert np.isfinite(float(loss))
+    assert float(loss) < first * 0.8, (first, float(loss))
+
+
+def test_dropout_station_changes_aggregate(engine):
+    cfg = engine.cfg
+    tokens = FT.make_federated_tokens(4, batch=2, seq_len=32, vocab=cfg.vocab)
+    sharded = engine.shard_tokens(tokens)
+    params, opt_state = engine.init(jax.random.key(1))
+    full_mask = jnp.ones(4)
+    drop_mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    p_full, _, _ = engine.round(params, opt_state, sharded, full_mask)
+    p_drop, _, _ = engine.round(params, opt_state, sharded, drop_mask)
+    # station 3's data influenced the full aggregate but not the dropped one
+    diff = jax.tree.leaves(
+        jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), p_full, p_drop)
+    )
+    assert max(diff) > 0
+
+
+def test_sequence_shards_see_full_context(engine):
+    """Perplexity must depend on cross-shard context: permuting the first
+    half of every sequence changes logits in the second half's shard."""
+    cfg = engine.cfg
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab, (4, 2, 32), dtype=np.int32)
+    params, _ = engine.init(jax.random.key(2))
+
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from vantage6_tpu.core.mesh import STATION_AXIS, shard_map
+
+    def logits_fn(params, toks):
+        def body(params, tokens_block):
+            out = FT.forward_local(params, tokens_block[0], cfg)
+            return out[None]
+
+        return shard_map(
+            body,
+            mesh=engine.mesh,
+            in_specs=(P(), P(STATION_AXIS, None, FT.SEQ_AXIS)),
+            out_specs=P(STATION_AXIS, None, FT.SEQ_AXIS),
+        )(params, engine.shard_tokens(toks))
+
+    base = np.asarray(logits_fn(params, tokens))
+    mutated = tokens.copy()
+    mutated[:, :, :8] = rng.integers(0, cfg.vocab, (4, 2, 8))  # first shard half
+    changed = np.asarray(logits_fn(params, mutated))
+    # positions in the SECOND half (owned by the other sequence shard) react
+    assert np.abs(base[:, :, 20:] - changed[:, :, 20:]).max() > 1e-6

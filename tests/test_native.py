@@ -1,0 +1,141 @@
+"""Native secure-agg kernels: RFC vector, C++ <-> numpy equivalence,
+mask cancellation, and the node-upload/server-sum flow."""
+import numpy as np
+import pytest
+
+from vantage6_tpu import native
+
+
+RFC_KEY = bytes(range(32))
+RFC_NONCE = bytes.fromhex("000000090000004a00000000")
+# RFC 8439 §2.3.2 test vector, block counter 1 (first block here is counter 0)
+RFC_BLOCK1_FIRST_WORDS = [0xE4E7F110, 0x15593BD1, 0x1FDD0F50, 0xC47120A3]
+
+
+def test_chacha20_rfc_vector():
+    # words 16..19 are the start of block counter 1
+    stream = native._chacha20_stream_np(RFC_KEY, RFC_NONCE, 32)
+    assert list(stream[16:20]) == RFC_BLOCK1_FIRST_WORDS
+
+
+@pytest.mark.skipif(not native.native_available(), reason="no g++")
+class TestNativeVsNumpy:
+    def test_chacha20_bit_identical(self):
+        n = 1000
+        a = native.chacha20_stream(RFC_KEY, RFC_NONCE, n)  # native
+        b = native._chacha20_stream_np(RFC_KEY, RFC_NONCE, n)
+        np.testing.assert_array_equal(a, b)
+
+    def test_masking_bit_identical(self, monkeypatch):
+        rng = np.random.default_rng(0)
+        vals = rng.normal(0, 3, 513).astype(np.float32)
+        seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        q = native.quantize(vals, 2.0**16)
+        m_native = native.add_pairwise_masks(seed, 2, 5, q)
+        monkeypatch.setenv("V6T_DISABLE_NATIVE", "1")
+        native.lib.cache_clear()
+        try:
+            m_fallback = native.add_pairwise_masks(seed, 2, 5, q)
+        finally:
+            monkeypatch.delenv("V6T_DISABLE_NATIVE")
+            native.lib.cache_clear()
+        np.testing.assert_array_equal(m_native, m_fallback)
+
+    def test_quantize_roundtrip_identical(self):
+        rng = np.random.default_rng(1)
+        vals = rng.normal(0, 10, 777).astype(np.float32)
+        q = native.quantize(vals, 2.0**16)
+        back = native.dequantize(q, 2.0**16)
+        assert np.max(np.abs(back - vals)) < 1.0 / 2.0**15
+
+    def test_dequantize_bit_identical_beyond_2p24(self, monkeypatch):
+        # |q| > 2^24: float64-then-cast would differ from the C++ kernel's
+        # float32 cast-then-divide
+        q = np.asarray([16777217, -16777219, 2**30], np.int32)
+        a = native.dequantize(q, 2.0**16)
+        monkeypatch.setenv("V6T_DISABLE_NATIVE", "1")
+        native.lib.cache_clear()
+        try:
+            b = native.dequantize(q, 2.0**16)
+        finally:
+            monkeypatch.delenv("V6T_DISABLE_NATIVE")
+            native.lib.cache_clear()
+        np.testing.assert_array_equal(a, b)
+
+    def test_guard_boundary_in_float32(self):
+        # guard computes in the kernels' own float32 arithmetic: the largest
+        # f32 below 32768 quantizes safely (product 2147483520 < 2^31) while
+        # 32768.0 itself is rejected
+        edge = np.nextafter(np.float32(32768.0), np.float32(0))
+        q = native.quantize(np.asarray([edge], np.float32), 2.0**16)
+        assert q[0] == 2147483520
+        with pytest.raises(ValueError, match="overflow"):
+            native.quantize(np.asarray([32768.0], np.float32), 2.0**16)
+
+    def test_chacha_stream_validates_lengths(self):
+        with pytest.raises(ValueError, match="32 bytes"):
+            native.chacha20_stream(b"short", b"0" * 12, 4)
+
+
+class TestSecureFlow:
+    def test_masks_cancel_exactly(self):
+        rng = np.random.default_rng(7)
+        n_stations, dim = 6, 1024
+        seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        updates = rng.normal(0, 1, (n_stations, dim)).astype(np.float32)
+        uploads = np.stack(
+            [
+                native.mask_update(seed, s, n_stations, updates[s])
+                for s in range(n_stations)
+            ]
+        )
+        # an individual upload reveals nothing recognizable: it differs
+        # wildly from its quantized plaintext
+        q0 = native.quantize(updates[0], 2.0**16)
+        assert np.mean(uploads[0] == q0) < 0.01
+        total = native.unmask_sum(uploads)
+        np.testing.assert_allclose(
+            total, updates.sum(axis=0), atol=n_stations / 2.0**15
+        )
+
+    def test_two_stations(self):
+        seed = b"s" * 32
+        a = native.mask_update(seed, 0, 2, np.asarray([1.5, -2.25], np.float32))
+        b = native.mask_update(seed, 1, 2, np.asarray([0.5, 0.25], np.float32))
+        out = native.unmask_sum(np.stack([a, b]))
+        np.testing.assert_allclose(out, [2.0, -2.0], atol=1e-4)
+
+    def test_wrap_sum_matches_int_semantics(self):
+        x = np.asarray(
+            [[2**31 - 1, -5], [1, -5]], np.int32
+        )  # overflow wraps, like on-device int32
+        out = native.sum_wrapping(x)
+        assert out[0] == -(2**31) + 0  # wrapped
+        assert out[1] == -10
+
+    def test_bad_seed_rejected(self):
+        with pytest.raises(ValueError, match="32 bytes"):
+            native.add_pairwise_masks(b"short", 0, 2, np.zeros(4, np.int32))
+
+    def test_quantize_overflow_raises_not_wraps(self):
+        # 2.3e6 * 2^16 >> int32: silent wrap would corrupt aggregates
+        big = np.asarray([2.3e6], np.float32)
+        with pytest.raises(ValueError, match="overflow"):
+            native.quantize(big, 2.0**16)
+        assert native.quantize(big, 256.0)[0] > 0  # fits at a smaller scale
+
+
+def test_fallback_flow_without_native(monkeypatch):
+    monkeypatch.setenv("V6T_DISABLE_NATIVE", "1")
+    native.lib.cache_clear()
+    try:
+        assert not native.native_available()
+        seed = b"x" * 32
+        ups = [
+            native.mask_update(seed, s, 3, np.full(10, float(s), np.float32))
+            for s in range(3)
+        ]
+        out = native.unmask_sum(np.stack(ups))
+        np.testing.assert_allclose(out, np.full(10, 3.0), atol=1e-3)
+    finally:
+        native.lib.cache_clear()

@@ -1,0 +1,95 @@
+"""Secure average workload: on the Federation runtime and over the full
+REST stack — the aggregator must never see plaintext contributions."""
+import secrets
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from vantage6_tpu.runtime.federation import federation_from_datasets
+from vantage6_tpu.workloads import secure_average
+
+
+@pytest.fixture()
+def frames():
+    rng = np.random.default_rng(11)
+    return [
+        pd.DataFrame({"age": rng.normal(45 + 5 * i, 6, 80)}) for i in range(3)
+    ]
+
+
+def test_secure_average_federation(frames):
+    fed = federation_from_datasets(
+        frames, {"v6-secure-average": secure_average}
+    )
+    seed = secrets.token_bytes(32).hex()
+    task = fed.create_task(
+        "v6-secure-average",
+        {
+            "method": "central_secure_average",
+            # max_abs bounds |sum| per party; 2^16 here -> scale ~5461
+            "kwargs": {"column": "age", "seed_hex": seed, "max_abs": 2.0**16},
+        },
+        organizations=[0],
+    )
+    out = fed.wait_for_results(task.id)[0]
+    pooled = pd.concat(frames)["age"]
+    assert out["count"] == len(pooled)
+    assert abs(out["average"] - pooled.mean()) < 1e-3  # quantization only
+
+    # privacy invariant: every partial's stored result is masked — it must
+    # not resemble the quantized plaintext
+    from vantage6_tpu import native
+
+    scale = 2.0**30 / (3 * 2.0**16)
+    for t in fed.tasks.values():
+        if t.method != "partial_secure_average":
+            continue
+        for run in t.runs:
+            idx = run.result["party_index"]
+            plain = np.asarray(
+                [frames[idx]["age"].sum(), len(frames[idx])], np.float32
+            )
+            q = native.quantize(plain, scale)
+            assert not np.array_equal(np.asarray(run.result["masked"]), q)
+
+
+def test_large_sums_do_not_wrap(frames):
+    """The derived scale keeps big aggregates inside int32 (no silent wrap)."""
+    rng = np.random.default_rng(3)
+    big = [
+        pd.DataFrame({"income": rng.lognormal(10, 0.4, 100)}) for _ in range(3)
+    ]
+    fed = federation_from_datasets(big, {"v6-secure-average": secure_average})
+    task = fed.create_task(
+        "v6-secure-average",
+        {
+            "method": "central_secure_average",
+            "kwargs": {"column": "income", "seed_hex": "ab" * 32},
+        },
+        organizations=[0],
+    )
+    out = fed.wait_for_results(task.id)[0]
+    pooled = pd.concat(big)["income"]
+    assert out["count"] == 300
+    assert abs(out["average"] - pooled.mean()) / pooled.mean() < 1e-3
+
+
+def test_secure_average_rejects_single_party(frames):
+    fed = federation_from_datasets(
+        frames[:1] * 2, {"v6-secure-average": secure_average}
+    )
+    task = fed.create_task(
+        "v6-secure-average",
+        {
+            "method": "central_secure_average",
+            "kwargs": {
+                "column": "age",
+                "seed_hex": "00" * 32,
+                "organizations": [0],
+            },
+        },
+        organizations=[0],
+    )
+    with pytest.raises(RuntimeError, match="2 parties"):
+        fed.wait_for_results(task.id)

@@ -29,7 +29,17 @@ from vantage6_tpu.common.context import (
 )
 
 
-@click.group(name="v6t")
+class _FriendlyGroup(click.Group):
+    """Operator errors (bad/missing configs) print one line, not tracebacks."""
+
+    def invoke(self, ctx: click.Context):
+        try:
+            return super().invoke(ctx)
+        except ConfigurationError as e:
+            raise click.ClickException(str(e)) from None
+
+
+@click.group(name="v6t", cls=_FriendlyGroup)
 @click.version_option(package_name="vantage6-tpu")
 def cli() -> None:
     """vantage6-tpu: TPU-native federated analysis."""
@@ -45,6 +55,7 @@ BUILTIN_ALGORITHMS = {
     "v6-logistic-regression-py": "vantage6_tpu.workloads.logistic_regression",
     "v6-kaplan-meier-py": "vantage6_tpu.workloads.survival",
     "v6-fedavg-mnist": "vantage6_tpu.workloads.fedavg_mnist",
+    "v6-secure-average": "vantage6_tpu.workloads.secure_average",
 }
 
 
@@ -66,8 +77,10 @@ def _alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
         return True
-    except (ProcessLookupError, PermissionError):
+    except ProcessLookupError:
         return False
+    except PermissionError:
+        return True  # EPERM: exists, owned by another user
 
 
 def _start_detached(ctx, runner_arg: str) -> int:
@@ -94,13 +107,25 @@ def _stop_instance(ctx) -> bool:
     if not _alive(pid):
         pidfile.unlink(missing_ok=True)  # stale
         return False
-    os.kill(pid, signal.SIGTERM)
+
+    def _signal(sig: int) -> None:
+        try:
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            pass  # exited between the liveness check and the signal
+        except PermissionError:
+            raise click.ClickException(
+                f"pid {pid} belongs to another user (recycled pid?); "
+                f"remove {pidfile} by hand if this instance is gone"
+            ) from None
+
+    _signal(signal.SIGTERM)
     for _ in range(50):
         if not _alive(pid):
             break
         time.sleep(0.1)
     else:
-        os.kill(pid, signal.SIGKILL)  # did not honor SIGTERM in 5s
+        _signal(signal.SIGKILL)  # did not honor SIGTERM in 5s
         for _ in range(20):
             if not _alive(pid):
                 break
@@ -146,7 +171,13 @@ def node_new(name: str, api_url: str, api_key: str, databases: tuple[str]) -> No
     """Create a node instance config."""
     dbs = []
     for spec in databases:
-        label, typ, uri = (spec.split(":", 2) + ["", ""])[:3]
+        parts = spec.split(":", 2)
+        if len(parts) != 3 or not parts[2]:
+            raise click.ClickException(
+                f"--database {spec!r}: expected label:type:uri "
+                "(e.g. default:csv:/data/x.csv)"
+            )
+        label, typ, uri = parts
         dbs.append({"label": label or "default", "type": typ or "csv", "uri": uri})
     ctx = NodeContext.create(
         name,
@@ -307,6 +338,25 @@ def _import_entities(app, entities: dict) -> dict:
         # orgs may come from this file OR already exist in the database
         return m.Organization.first(name=name) if name else None
 
+    # validate EVERY reference up front: a failure mid-import would strand
+    # partially-seeded entities and lose already-generated node api keys
+    file_orgs = {o["name"] for o in entities.get("organizations", []) or []}
+
+    def known(name: str | None) -> bool:
+        return bool(name) and (name in file_orgs or org_by_name(name) is not None)
+
+    for user in entities.get("users", []) or []:
+        if user.get("organization") and not known(user["organization"]):
+            raise click.ClickException(
+                f"user {user['username']}: unknown org {user['organization']}"
+            )
+    for collab in entities.get("collaborations", []) or []:
+        for org_name in collab.get("participants", []) or []:
+            if not known(org_name):
+                raise click.ClickException(
+                    f"collaboration {collab['name']}: unknown org {org_name}"
+                )
+
     for org in entities.get("organizations", []) or []:
         row = m.Organization.first(name=org["name"])
         if row is None:
@@ -320,10 +370,6 @@ def _import_entities(app, entities: dict) -> dict:
         if m.User.first(username=user["username"]) is not None:
             continue
         org = org_by_name(user.get("organization"))
-        if user.get("organization") and org is None:
-            raise click.ClickException(
-                f"user {user['username']}: unknown org {user['organization']}"
-            )
         row = m.User(
             username=user["username"],
             organization_id=org.id if org else None,
@@ -345,11 +391,7 @@ def _import_entities(app, entities: dict) -> dict:
             ).save()
             created["collaborations"] += 1
         for org_name in collab.get("participants", []) or []:
-            org = org_by_name(org_name)
-            if org is None:
-                raise click.ClickException(
-                    f"collaboration {collab['name']}: unknown org {org_name}"
-                )
+            org = org_by_name(org_name)  # pre-validated above
             row.add_organization(org)
             node = m.Node.first(
                 collaboration_id=row.id, organization_id=org.id
@@ -534,14 +576,20 @@ def dev_start(name: str) -> None:
     import requests
 
     url = f"http://127.0.0.1:{server_ctx.port}/api/health"
-    for _ in range(100):
+    # monotonic: wall-clock steps (NTP) must not expire the wait
+    deadline = time.monotonic() + 120  # cold jax import takes a while
+    while True:
         try:
             if requests.get(url, timeout=1).status_code == 200:
                 break
         except requests.RequestException:
-            time.sleep(0.1)
-    else:
-        raise click.ClickException("server did not come up")
+            pass
+        if time.monotonic() > deadline:
+            raise click.ClickException(
+                "server did not come up within 120s — check "
+                f"{server_ctx.log_dir / 'stdout.log'}"
+            )
+        time.sleep(0.25)
     for node_name in NodeContext.available_configurations():
         if node_name.startswith(f"{name}_node_"):
             pid = _start_detached(NodeContext(node_name), "_run-node")
@@ -708,10 +756,10 @@ def run_cmd(config: str, image: str, method: str, kwargs_json: str,
 
 
 @cli.command("test")
-@click.option("--keep", is_flag=True, help="keep the demo network afterwards")
-@click.pass_context
-def test_cmd(ctx: click.Context, keep: bool) -> None:
-    """Smoke test: demo network end-to-end (reference: `v6 test`)."""
+def test_cmd() -> None:
+    """Smoke test: in-process federation end-to-end (reference: `v6 test`)."""
+    import tempfile
+
     import numpy as np
     import pandas as pd
 
@@ -723,9 +771,8 @@ def test_cmd(ctx: click.Context, keep: bool) -> None:
     srv = ServerApp()
     srv.ensure_root(password="smoke-test-pw")
     http = srv.serve(port=0, background=True)
-    import tempfile
-
-    tmp = Path(tempfile.mkdtemp(prefix="v6t_smoke_"))
+    tmpdir = tempfile.TemporaryDirectory(prefix="v6t_smoke_")
+    tmp = Path(tmpdir.name)
     client = UserClient(http.url)
     client.authenticate("root", "smoke-test-pw")
     orgs = [client.organization.create(name=f"org{i}") for i in range(2)]
@@ -764,6 +811,7 @@ def test_cmd(ctx: click.Context, keep: bool) -> None:
             d.stop()
         http.stop()
         srv.close()
+        tmpdir.cleanup()
 
 
 if __name__ == "__main__":

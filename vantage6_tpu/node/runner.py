@@ -181,6 +181,12 @@ class TaskRunner:
             "RUN_ID": str(spec.run_id),
             "TEMPORARY_FOLDER": str(run_dir),
         }
+        if not self.policies.get("accelerator", False):
+            # sandboxed algorithms default to CPU, like the reference's
+            # containers: faster startup and no contention for (or hangs on)
+            # the host's accelerator; opt in via policies: {accelerator: true}
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
         if spec.server_url:
             env["V6T_SERVER_URL"] = spec.server_url
         labels = [

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for the hot ops (flash attention for long context)."""
+from vantage6_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,177 @@
+"""Pallas flash-attention block kernel (TPU).
+
+The hot op of the long-context path (fed_transformer + ring attention).
+XLA already fuses the einsum softmax chain reasonably; this kernel keeps the
+whole online-softmax loop in VMEM with no [Tq, Tk] materialization in HBM —
+the standard flash formulation (Dao et al. 2022) written natively for the
+MXU: scores and the weighted-value accumulation are back-to-back matmuls per
+(block_q, block_k) tile, accumulated in float32.
+
+``q_offset``/``k_offset`` are runtime scalars (prefetched) giving the global
+position of this shard's first query/key token, so the SAME kernel serves
+monolithic causal attention (offsets 0) and each hop of ring attention
+(offsets = shard index × shard length, see parallel.ring_attention).
+
+CPU tests run with ``interpret=True``; the jnp reference path doubles as the
+no-TPU fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    qoff_ref,
+    koff_ref,
+    kvalid_ref,
+    q_ref,  # [block_q, d]
+    k_ref,  # [t_k, d]
+    v_ref,  # [t_k, d]
+    o_ref,  # [block_q, d]
+    *,
+    causal: bool,
+    scale: float,
+    block_k: int,
+):
+    block_q, d = q_ref.shape
+    t_k = k_ref.shape[0]
+    n_kb = t_k // block_k
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    q_pos = (
+        qoff_ref[0]
+        + qi * block_q
+        + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    )
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        k_idx = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        # padded key slots (k_idx >= true Tk) never contribute
+        s = jnp.where(k_idx < kvalid_ref[0], s, NEG_INF)
+        if causal:
+            k_pos = koff_ref[0] + k_idx
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        blk_max = jnp.max(s, axis=1)
+        # clamp at a finite floor: for a fully-masked block, exp(s - m_new)
+        # must be exp(-huge) = 0, NOT exp(NEG_INF - NEG_INF) = 1
+        m_new = jnp.maximum(jnp.maximum(m, blk_max), -1e20)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr[:, None] + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    denom = jnp.where(l > 0, l, 1.0)
+    o_ref[:] = (acc / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, H, Tq, D]
+    k: jax.Array,  # [B, H, Tk, D]
+    v: jax.Array,  # [B, H, Tk, D]
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention per (batch, head); Tq/Tk padded to block multiples
+    internally. Layout [B, H, T, D] (head-major for clean 2D tiles)."""
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    block_q = min(block_q, max(t_q, 8))
+    block_k = min(block_k, max(t_k, 8))
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_k) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        # padded key slots are masked INSIDE the kernel via the k_valid
+        # scalar (offset arithmetic can otherwise place them inside the
+        # causal horizon)
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    tq_p, tk_p = t_q + pad_q, t_k + pad_k
+
+    qh = q.reshape(b * h, tq_p, d)
+    kh = k.reshape(b * h, tk_p, d)
+    vh = v.reshape(b * h, tk_p, d)
+
+    qoff = jnp.asarray([q_offset], jnp.int32)
+    koff = jnp.asarray([k_offset], jnp.int32)
+    kvalid = jnp.asarray([t_k], jnp.int32)
+
+    grid = (b * h, tq_p // block_q)
+    kernel = functools.partial(
+        _kernel, causal=causal, scale=scale, block_k=block_k
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((None, block_q, d), lambda bh, i, *_: (bh, i, 0)),
+                pl.BlockSpec((None, tk_p, d), lambda bh, i, *_: (bh, 0, 0)),
+                pl.BlockSpec((None, tk_p, d), lambda bh, i, *_: (bh, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (None, block_q, d), lambda bh, i, *_: (bh, i, 0)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        interpret=interpret,
+    )(qoff, koff, kvalid, qh, kh, vh)
+    out = out.reshape(b, h, tq_p, d)
+    return out[:, :, :t_q]
+
+
+def reference(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    q_offset: int = 0, k_offset: int = 0, causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """jnp oracle in the same [B, H, T, D] layout (also the CPU fallback)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])
+        k_pos = k_offset + jnp.arange(k.shape[2])
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

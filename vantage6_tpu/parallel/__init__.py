@@ -1,0 +1,16 @@
+"""Parallelism strategies beyond the station axis (SURVEY.md §2.3).
+
+- ring_attention: sequence/context parallelism over ICI (long context)
+- tensor: Megatron-style within-station tensor parallelism
+The station axis itself (cross-silo data parallelism) lives in core.mesh.
+"""
+from vantage6_tpu.parallel.ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+from vantage6_tpu.parallel.tensor import (  # noqa: F401
+    column_parallel_dense,
+    row_parallel_dense,
+    tp_mlp,
+)

@@ -1,0 +1,91 @@
+"""Tensor parallelism within a station's sub-mesh.
+
+The reference has no tensor parallelism (SURVEY.md §2.3) — its "model" is
+whatever a container does on one machine. Here a station owning
+``devices_per_station > 1`` shards its LOCAL model over the ``device`` mesh
+axis, Megatron-style: a column-parallel matmul (weights split on the output
+feature dim, no communication) feeding a row-parallel matmul (weights split
+on the input dim, one ``psum`` over ICI). Cross-station federation (the
+``station`` axis) composes orthogonally — the psum here never crosses
+stations, preserving the federated isolation contract.
+
+Functional layer; use inside ``shard_map`` bodies (e.g. fed_map partials)
+where ``axis_name`` is in scope.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_dense(
+    x: jax.Array, w_local: jax.Array, b_local: jax.Array | None = None
+) -> jax.Array:
+    """``[..., d_in] @ [d_in, d_out/P] -> [..., d_out/P]`` — no comm; the
+    output stays feature-sharded for the next (row-parallel) layer."""
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_dense(
+    x_local: jax.Array,
+    w_local: jax.Array,
+    axis_name: str,
+    b: jax.Array | None = None,
+) -> jax.Array:
+    """``[..., d_in/P] @ [d_in/P, d_out] -> [..., d_out]`` with one psum
+    over ``axis_name``; the bias is added AFTER the reduction (replicated)."""
+    y = lax.psum(x_local @ w_local, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(
+    x: jax.Array,
+    w_up_local: jax.Array,
+    w_down_local: jax.Array,
+    axis_name: str,
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+) -> jax.Array:
+    """The canonical 2-layer TP block: column-parallel up, activation,
+    row-parallel down — exactly one collective for the whole MLP."""
+    h = activation(column_parallel_dense(x, w_up_local))
+    return row_parallel_dense(h, w_down_local, axis_name)
+
+
+def shard_params_for_tp(
+    params: Any, axis_index: int, axis_size: int, rules: dict[str, int]
+) -> Any:
+    """Slice a replicated param pytree into this shard's local blocks.
+
+    ``rules`` maps a parameter path substring to the axis to split
+    (e.g. ``{"w_up": 1, "w_down": 0}``). Unmatched params stay replicated.
+    """
+
+    def slice_leaf(path: str, x: jax.Array) -> jax.Array:
+        for pat, dim in rules.items():
+            if pat in path:
+                size = x.shape[dim]
+                if size % axis_size:
+                    raise ValueError(
+                        f"{path}: dim {dim} ({size}) not divisible by "
+                        f"tp={axis_size}"
+                    )
+                block = size // axis_size
+                return lax.dynamic_slice_in_dim(
+                    x, axis_index * block, block, axis=dim
+                )
+        return x
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves, treedef = flat
+    out = [
+        slice_leaf(jax.tree_util.keystr(path), leaf) for path, leaf in leaves
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,121 @@
+"""Secure federated column average — host-path secure aggregation.
+
+Parity: the reference's secure-sum algorithm repos (Paillier-based partial
+sums; SURVEY.md §2.3 "secure aggregation"). The cross-host path uses
+pairwise additive masking with native ChaCha20 kernels
+(vantage6_tpu.native): each station uploads a masked fixed-point vector and
+the central step's wrapping sum cancels every mask. The on-pod equivalent
+is fed.collectives.secure_sum.
+
+THREAT MODEL — read before relying on this (same honesty note as
+fed.collectives and docs/THREAT_MODEL.md): masks derive from ONE shared
+seed, so the guarantee is scoped to observers who do NOT hold it — the
+relaying server in an E2E-encrypted collaboration (the seed travels inside
+the encrypted task payload), log/trace readers, and any party shown a
+single masked upload. A party holding the seed (including the central
+aggregator itself) CAN regenerate the masks and unmask individual uploads.
+Defending against an untrusted aggregator requires per-pair Diffie-Hellman
+mask secrets (Bonawitz et al.) so that no single party knows all masks; the
+collective structure here is identical — only key provisioning changes, and
+that upgrade is the planned next step for this workload. Provision the seed
+out-of-band (station configs), never through an unencrypted task payload.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from vantage6_tpu.algorithm.decorators import algorithm_client, data
+
+
+@data(1)
+def partial_secure_average(
+    df: Any,
+    column: str,
+    seed_hex: str,
+    party_index: int,
+    n_parties: int,
+    scale: float,
+    max_abs: float,
+) -> dict[str, Any]:
+    """Upload = masked [sum, count]; plaintext never leaves the station.
+
+    Values are clipped to ±max_abs — the range contract every party shares
+    so the fixed-point aggregate can NEVER wrap (see central's scale
+    derivation). A clipped sum is a bias, not corruption; widen max_abs if
+    your sums exceed it.
+    """
+    from vantage6_tpu import native
+
+    col = df[column]
+    vec = np.clip(
+        np.asarray([col.sum(), float(col.count())], np.float32),
+        -max_abs,
+        max_abs,
+    )
+    masked = native.mask_update(
+        bytes.fromhex(seed_hex), party_index, n_parties, vec, scale
+    )
+    return {"masked": masked, "party_index": party_index}
+
+
+@algorithm_client
+def central_secure_average(
+    client: Any,
+    column: str,
+    seed_hex: str,
+    organizations: list[int] | None = None,
+    max_abs: float = 2.0**24,
+) -> dict[str, Any]:
+    """Fan out masked partials; the wrapping sum cancels the masks.
+
+    Privacy is against observers WITHOUT the seed (see the module threat
+    model) — this central function holds the seed and could unmask; the
+    protection is for the transport/relay path.
+
+    ``max_abs`` bounds every party's |sum| and |count| (values are clipped
+    at the stations); the fixed-point scale is derived as
+    ``2^30 / (n_parties * max_abs)`` so the n-party aggregate provably fits
+    in int32 — no silent wrap-around. Precision of the result is 1/scale.
+    """
+    from vantage6_tpu import native
+
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    n = len(orgs)
+    if n < 2:
+        raise ValueError(
+            "secure aggregation needs >= 2 parties (a single masked upload "
+            "would be trivially unmaskable by the seed holder)"
+        )
+    scale = 2.0**30 / (n * max_abs)
+    # one subtask per org: each party must learn its own party_index
+    uploads = []
+    subtasks = []
+    for idx, org in enumerate(orgs):
+        subtasks.append(
+            client.task.create(
+                input_={
+                    "method": "partial_secure_average",
+                    "kwargs": {
+                        "column": column,
+                        "seed_hex": seed_hex,
+                        "party_index": idx,
+                        "n_parties": n,
+                        "scale": scale,
+                        "max_abs": max_abs,
+                    },
+                },
+                organizations=[org],
+                name=f"secure_partial_{idx}",
+            )
+        )
+    for sub in subtasks:
+        result = client.wait_for_results(task_id=sub["id"])[0]
+        uploads.append(np.asarray(result["masked"], np.int32))
+    total = native.unmask_sum(np.stack(uploads), scale)
+    g_sum, g_count = float(total[0]), float(total[1])
+    return {
+        "average": g_sum / g_count if g_count else float("nan"),
+        "count": int(round(g_count)),
+    }
