@@ -197,19 +197,74 @@ class NodeDaemon:
 
     # ---------------------------------------------------------------- listen
     def _listen(self) -> None:
+        """Prefer websocket push (SocketIO parity); the REST cursor remains
+        the fallback AND the gap-filler after any socket drop."""
+        discover_at = 0.0
+        ws_url: str | None = None
         while not self._stop.is_set():
-            try:
-                batch = self.request(
-                    "GET", "event", params={"since": self._cursor}
-                )
-            except Exception as e:
-                log.warning("event poll failed: %s", e)
-                self._stop.wait(self.poll_interval * 4)
-                continue
-            self._cursor = max(self._cursor, batch["cursor"])
-            for event in batch["data"]:
-                self._handle(event)
+            now = time.monotonic()
+            if now >= discover_at:
+                ws_url = self._discover_ws()
+                # no bridge on the server is the steady state for polling
+                # deployments — don't double request load re-asking every
+                # cycle; after a drop the next re-discovery is soon enough
+                discover_at = now + (10.0 if ws_url is None else 1.0)
+            if ws_url:
+                self._listen_ws(ws_url)  # returns on disconnect or stop
+                if self._stop.is_set():
+                    return
+                discover_at = 0.0  # re-discover after a drop
+            # polling sweep: fallback transport and post-drop catch-up
+            self._poll_once()
             self._stop.wait(self.poll_interval)
+
+    def _discover_ws(self) -> str | None:
+        try:
+            return self.request("GET", "health").get("websocket_url")
+        except Exception:
+            return None
+
+    def _poll_once(self) -> None:
+        try:
+            batch = self.request("GET", "event", params={"since": self._cursor})
+        except Exception as e:
+            log.warning("event poll failed: %s", e)
+            self._stop.wait(self.poll_interval * 4)
+            return
+        self._cursor = max(self._cursor, batch["cursor"])
+        for event in batch["data"]:
+            self._handle(event)
+
+    def _listen_ws(self, ws_url: str) -> None:
+        import json as _json
+
+        try:
+            # inside the try: a missing websockets package must degrade to
+            # polling, not kill the listen thread
+            from websockets.sync.client import connect
+
+            with connect(ws_url) as ws:
+                ws.send(
+                    _json.dumps(
+                        {"token": self._access_token, "since": self._cursor}
+                    )
+                )
+                hello = _json.loads(ws.recv(timeout=10))
+                if not hello.get("connected"):
+                    log.warning("ws auth rejected: %s", hello)
+                    return
+                log.info("event push connected (%s)", ws_url)
+                while not self._stop.is_set():
+                    try:
+                        msg = _json.loads(ws.recv(timeout=self.poll_interval))
+                    except TimeoutError:
+                        continue
+                    event = msg.get("event")
+                    if event:
+                        self._cursor = max(self._cursor, event["seq"])
+                        self._handle(event)
+        except Exception as e:
+            log.warning("event push dropped (%s); falling back to polling", e)
 
     def _handle(self, event: dict[str, Any]) -> None:
         name, data = event["name"], event["data"]

@@ -7,7 +7,7 @@ ensure a root user, register the REST resources and the event hub, serve.
 from __future__ import annotations
 
 import time
-from typing import Callable
+from typing import Any, Callable
 
 from vantage6_tpu.common.context import ServerContext
 from vantage6_tpu.common.log import setup_logging
@@ -37,12 +37,23 @@ class ServerApp:
         # optional algorithm-store gate: image -> allowed? (SURVEY §2 item 9;
         # wired up by the store service or a static allow-list)
         self.algorithm_policy = algorithm_policy
+        self.ws_url: str | None = None  # set by an attached WebSocketBridge
+        self._bridges: list[Any] = []  # stopped in close()
         self.app = App("vantage6_tpu-server")
         register_resources(self)
+        from vantage6_tpu.server.ui import register_ui
+
+        register_ui(self)
 
     def close(self) -> None:
-        """Release the database binding (required before a new ServerApp in
-        the same process — see models.init)."""
+        """Stop attached bridges and release the database binding (required
+        before a new ServerApp in the same process — see models.init)."""
+        for bridge in list(self._bridges):
+            try:
+                bridge.stop()
+            except Exception:  # pragma: no cover
+                pass
+        self._bridges.clear()
         self.db.close()
         models.Model.db = None
 
@@ -74,6 +85,14 @@ class ServerApp:
     # ---------------------------------------------------------------- serve
     def test_client(self) -> TestClient:
         return TestClient(self.app)
+
+    def serve_ws(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the SocketIO-equivalent push bridge (SURVEY §2 item 6)."""
+        from vantage6_tpu.server.ws import WebSocketBridge
+
+        bridge = WebSocketBridge(self, host, port).start_background()
+        self._bridges.append(bridge)
+        return bridge
 
     def serve(
         self, host: str = "127.0.0.1", port: int = 7601, background: bool = False

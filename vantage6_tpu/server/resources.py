@@ -25,8 +25,9 @@ if TYPE_CHECKING:  # pragma: no cover
 # --------------------------------------------------------------- auth helpers
 
 
-def _identity(srv: "ServerApp", req: Request) -> tuple[str, Any]:
-    token = req.bearer_token
+def identity_from_token(srv: "ServerApp", token: str | None) -> tuple[str, Any]:
+    """Resolve a bearer token to (kind, principal); raises HTTPError(401).
+    Shared by the REST auth path and the websocket bridge."""
     if not token:
         raise HTTPError(401, "missing bearer token")
     try:
@@ -47,6 +48,10 @@ def _identity(srv: "ServerApp", req: Request) -> tuple[str, Any]:
     if kind == "container":
         return "container", sub
     raise HTTPError(401, "unknown principal type")
+
+
+def _identity(srv: "ServerApp", req: Request) -> tuple[str, Any]:
+    return identity_from_token(srv, req.bearer_token)
 
 
 def _require_user(srv: "ServerApp", req: Request) -> m.User:
@@ -127,7 +132,12 @@ def register_resources(srv: "ServerApp") -> None:
     # ------------------------------------------------------------- service
     @app.route("/api/health")
     def health(req: Request):
-        return {"status": "ok", "uptime": time.time() - srv.started_at}
+        return {
+            "status": "ok",
+            "uptime": time.time() - srv.started_at,
+            # advertised so nodes/UIs can upgrade from polling to push
+            "websocket_url": srv.ws_url,
+        }
 
     @app.route("/api/version")
     def version(req: Request):

@@ -1,0 +1,253 @@
+"""Built-in web UI.
+
+Parity: the reference ships an Angular SPA (SURVEY.md §2 item 27) for
+administration and task management. Here a dependency-free single-page app
+(vanilla JS + the server's own REST API) is served by the control plane
+itself at ``/`` — login, collaborations, node liveness, task submission and
+result inspection. Deliberately buildless: one HTML document, no bundler,
+no CDN (zero-egress deployments), trivially auditable.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from vantage6_tpu.server.web import Request, Response
+
+if TYPE_CHECKING:  # pragma: no cover
+    from vantage6_tpu.server.app import ServerApp
+
+PAGE = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>vantage6-tpu</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+:root { --bg:#10141a; --panel:#1a212b; --text:#e6e9ee; --dim:#8b97a6;
+        --accent:#4fa3ff; --ok:#3fb97c; --bad:#e0635c; --warn:#d9a441; }
+* { box-sizing:border-box; }
+body { margin:0; background:var(--bg); color:var(--text);
+       font:14px/1.5 system-ui, sans-serif; }
+header { display:flex; align-items:center; gap:1rem; padding:.7rem 1.2rem;
+         background:var(--panel); border-bottom:1px solid #2a3442; }
+header h1 { font-size:1rem; margin:0; letter-spacing:.04em; }
+header .who { margin-left:auto; color:var(--dim); }
+main { max-width:1100px; margin:1.2rem auto; padding:0 1rem; }
+.panel { background:var(--panel); border:1px solid #2a3442; border-radius:8px;
+         padding:1rem 1.2rem; margin-bottom:1rem; }
+h2 { font-size:.85rem; text-transform:uppercase; letter-spacing:.08em;
+     color:var(--dim); margin:.2rem 0 .8rem; }
+table { width:100%; border-collapse:collapse; }
+th, td { text-align:left; padding:.35rem .5rem; border-bottom:1px solid #242e3b; }
+th { color:var(--dim); font-weight:500; }
+tr:hover td { background:#202a36; }
+input, select, textarea, button {
+  background:#0d1117; color:var(--text); border:1px solid #2a3442;
+  border-radius:6px; padding:.45rem .6rem; font:inherit; }
+button { background:var(--accent); color:#081018; border:none; cursor:pointer;
+         font-weight:600; }
+button.ghost { background:transparent; color:var(--accent);
+               border:1px solid var(--accent); }
+.badge { padding:.1rem .5rem; border-radius:10px; font-size:.75rem; }
+.badge.online, .badge.completed { background:#15392a; color:var(--ok); }
+.badge.offline, .badge.crashed, .badge.failed { background:#3d1f1d; color:var(--bad); }
+.badge.pending, .badge.active { background:#3a2f16; color:var(--warn); }
+.row { display:flex; gap:.6rem; flex-wrap:wrap; align-items:center; }
+#login { max-width:360px; margin:14vh auto; }
+.err { color:var(--bad); min-height:1.2em; }
+pre { background:#0d1117; padding:.6rem; border-radius:6px; overflow:auto; }
+a { color:var(--accent); cursor:pointer; }
+.hidden { display:none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>vantage6-tpu</h1>
+  <span id="version" class="who"></span>
+  <span id="whoami" class="who"></span>
+  <button id="logout" class="ghost hidden">log out</button>
+</header>
+<main>
+  <div id="login" class="panel">
+    <h2>Sign in</h2>
+    <div class="row" style="flex-direction:column; align-items:stretch">
+      <input id="username" placeholder="username" autocomplete="username">
+      <input id="password" type="password" placeholder="password"
+             autocomplete="current-password">
+      <input id="mfa" placeholder="MFA code (if enabled)">
+      <button id="signin">Sign in</button>
+      <div id="loginerr" class="err"></div>
+    </div>
+  </div>
+
+  <div id="appview" class="hidden">
+    <div class="panel">
+      <h2>Nodes</h2>
+      <table id="nodes"><thead><tr>
+        <th>name</th><th>organization</th><th>collaboration</th><th>status</th>
+      </tr></thead><tbody></tbody></table>
+    </div>
+    <div class="panel">
+      <h2>Collaborations</h2>
+      <table id="collabs"><thead><tr>
+        <th>id</th><th>name</th><th>encrypted</th><th>organizations</th>
+      </tr></thead><tbody></tbody></table>
+    </div>
+    <div class="panel">
+      <h2>New task</h2>
+      <div class="row">
+        <select id="t_collab"></select>
+        <input id="t_image" placeholder="algorithm image" size="22">
+        <input id="t_method" placeholder="method" size="16">
+        <input id="t_kwargs" placeholder='kwargs JSON, e.g. {"column":"age"}'
+               size="30">
+        <button id="t_create">Create</button>
+      </div>
+      <div id="taskerr" class="err"></div>
+    </div>
+    <div class="panel">
+      <h2>Tasks</h2>
+      <table id="tasks"><thead><tr>
+        <th>id</th><th>name</th><th>image</th><th>method</th><th>status</th>
+      </tr></thead><tbody></tbody></table>
+    </div>
+    <div class="panel hidden" id="detailpanel">
+      <h2>Task <span id="d_id"></span></h2>
+      <table id="runs"><thead><tr>
+        <th>run</th><th>organization</th><th>status</th><th>result / log</th>
+      </tr></thead><tbody></tbody></table>
+    </div>
+  </div>
+</main>
+<script>
+"use strict";
+let token = sessionStorage.getItem("v6t_token") || null;
+const $ = (id) => document.getElementById(id);
+
+// every server-sourced string goes through esc() before innerHTML — task
+// names/images/logs are collaborator-controlled input (stored-XSS vector)
+function esc(v) {
+  return String(v ?? "").replace(/[&<>"']/g, (c) => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  })[c]);
+}
+
+async function api(method, path, body) {
+  const opts = { method, headers: {} };
+  if (token) opts.headers["Authorization"] = "Bearer " + token;
+  if (body !== undefined) {
+    opts.headers["Content-Type"] = "application/json";
+    opts.body = JSON.stringify(body);
+  }
+  const resp = await fetch("/api/" + path, opts);
+  const data = resp.status === 204 ? {} : await resp.json();
+  if (!resp.ok) throw new Error(data.msg || resp.statusText);
+  return data;
+}
+
+function badge(status) {
+  const cls = esc(String(status).split(" ")[0]);
+  return `<span class="badge ${cls}">${esc(status)}</span>`;
+}
+
+function fill(tableId, rows, renderer) {
+  $(tableId).querySelector("tbody").innerHTML = rows.map(renderer).join("");
+}
+
+async function refresh() {
+  const [nodes, collabs, tasks] = await Promise.all([
+    api("GET", "node"), api("GET", "collaboration"), api("GET", "task"),
+  ]);
+  fill("nodes", nodes.data, (n) =>
+    `<tr><td>${esc(n.name)}</td><td>${esc(n.organization.id)}</td>` +
+    `<td>${esc(n.collaboration.id)}</td><td>${badge(n.status)}</td></tr>`);
+  fill("collabs", collabs.data, (c) =>
+    `<tr><td>${esc(c.id)}</td><td>${esc(c.name)}</td><td>${c.encrypted}</td>` +
+    `<td>${esc(c.organizations.join(", "))}</td></tr>`);
+  // encrypted collaborations need client-side key material the browser UI
+  // does not hold — exclude them from task submission
+  $("t_collab").innerHTML = collabs.data.filter((c) => !c.encrypted).map(
+    (c) => `<option value="${Number(c.id)}">${esc(c.name)}</option>`).join("");
+  fill("tasks", tasks.data.slice().reverse(), (t) =>
+    `<tr><td><a onclick="showTask(${Number(t.id)})">${Number(t.id)}</a></td>` +
+    `<td>${esc(t.name)}</td><td>${esc(t.image)}</td>` +
+    `<td>${esc(t.method || "")}</td><td>${badge(t.status)}</td></tr>`);
+}
+
+window.showTask = async function (id) {
+  const runs = await api("GET", `task/${id}/run`);
+  $("d_id").textContent = id;
+  $("detailpanel").classList.remove("hidden");
+  fill("runs", runs.data, (r) =>
+    `<tr><td>${Number(r.id)}</td><td>${esc(r.organization.id)}</td>` +
+    `<td>${badge(r.status)}</td>` +
+    `<td><pre>${esc((r.result || r.log || "").slice(0, 400))}</pre></td></tr>`);
+};
+
+async function enter() {
+  $("login").classList.add("hidden");
+  $("appview").classList.remove("hidden");
+  $("logout").classList.remove("hidden");
+  await refresh();
+}
+
+$("signin").onclick = async () => {
+  try {
+    const data = await api("POST", "token/user", {
+      username: $("username").value,
+      password: $("password").value,
+      mfa_code: $("mfa").value || null,
+    });
+    token = data.access_token;
+    sessionStorage.setItem("v6t_token", token);
+    $("whoami").textContent = data.user.username;
+    await enter();
+  } catch (e) { $("loginerr").textContent = e.message; }
+};
+
+$("logout").onclick = () => {
+  sessionStorage.removeItem("v6t_token"); location.reload();
+};
+
+$("t_create").onclick = async () => {
+  try {
+    $("taskerr").textContent = "";
+    let kwargs = {};
+    if ($("t_kwargs").value.trim()) kwargs = JSON.parse($("t_kwargs").value);
+    const collab = parseInt($("t_collab").value, 10);
+    const orgs = (await api("GET", `collaboration/${collab}`)).organizations;
+    const input = { method: $("t_method").value, kwargs };
+    // unencrypted collaborations: plain base64 payload per org
+    const blob = btoa(JSON.stringify(input));
+    await api("POST", "task", {
+      name: "ui task", image: $("t_image").value,
+      method: $("t_method").value, collaboration_id: collab,
+      organizations: orgs.map((id) => ({ id, input: blob })),
+    });
+    await refresh();
+  } catch (e) { $("taskerr").textContent = e.message; }
+};
+
+api("GET", "version").then((v) => $("version").textContent = "v" + v.version);
+if (token) {
+  api("GET", "whoami").then((w) => {
+    $("whoami").textContent = w.username; enter();  // textContent: no XSS
+  }).catch(() => { token = null; sessionStorage.removeItem("v6t_token"); });
+}
+setInterval(() => { if (token && !$("appview").classList.contains("hidden"))
+  refresh().catch(() => {}); }, 3000);
+</script>
+</body>
+</html>
+"""
+
+
+def register_ui(srv: "ServerApp") -> None:
+    app = srv.app
+
+    @app.route("/")
+    @app.route("/ui")
+    def ui(req: Request):
+        return Response(
+            PAGE.encode(), headers={"Content-Type": "text/html; charset=utf-8"}
+        )
