@@ -323,3 +323,120 @@ class TestRunnerSandbox:
                                    "kwargs": {"column": "x"}},
                 )
             )
+
+
+def test_result_delivery_failure_marks_run_failed(stack, tmp_path):
+    """Regression (ADVICE r1): if encrypting/uploading the result fails
+    (here: the initiating org's public key is garbage), the run must be
+    patched FAILED with a log — not stuck ACTIVE with the result lost."""
+    client_plain, tmp = stack["client"], stack["tmp"]
+    orgs = [
+        client_plain.organization.create(name=n) for n in ("del_a", "del_b")
+    ]
+    collab = client_plain.collaboration.create(
+        name="delivery", encrypted=True,
+        organization_ids=[o["id"] for o in orgs],
+    )
+    node_info = client_plain.node.create(
+        organization_id=orgs[1]["id"], collaboration_id=collab["id"]
+    )
+    daemon = NodeDaemon(
+        api_url=stack["http"].url,
+        api_key=node_info["api_key"],
+        algorithms={"v6-average-py": "vantage6_tpu.workloads.average"},
+        databases=[
+            {"label": "default", "type": "csv",
+             "uri": str(tmp / "hospital_b.csv")}
+        ],
+        private_key=tmp_path / "del_b.pem",
+        mode="inline",
+        poll_interval=0.05,
+    )
+    daemon.start()
+    try:
+        researcher_role = next(
+            r for r in client_plain.role.list() if r["name"] == "Researcher"
+        )
+        client_plain.user.create(
+            username="dave",
+            password="davepass1234",
+            organization_id=orgs[0]["id"],
+            roles=[researcher_role["id"]],
+        )
+        # provision dave's org keypair as root (a Researcher may not PATCH
+        # the org), then let setup_encryption find it already registered
+        from vantage6_tpu.common.encryption import RSACryptor
+
+        cryptor = RSACryptor(tmp_path / "del_a.pem")
+        client_plain.organization.update(
+            orgs[0]["id"], public_key=cryptor.public_key_str
+        )
+        dave = UserClient(stack["http"].url)
+        dave.authenticate("dave", "davepass1234")
+        dave.setup_encryption(tmp_path / "del_a.pem")
+        # corrupt the INITIATING org's public key AFTER client setup: the
+        # node's result encryption toward it must now fail
+        client_plain.organization.update(
+            orgs[0]["id"], public_key="not-a-valid-key"
+        )
+        task = dave.task.create(
+            collaboration=collab["id"],
+            organizations=[orgs[1]["id"]],
+            image="v6-average-py",
+            input_={"method": "partial_average", "kwargs": {"column": "age"}},
+        )
+        deadline = time.time() + 30
+        run = None
+        while time.time() < deadline:
+            run = client_plain.run.from_task(task["id"])[0]
+            if run["status"] not in ("pending", "active"):
+                break
+            time.sleep(0.05)
+        assert run is not None and run["status"] == "failed", run
+        assert "result delivery failed" in (run["log"] or "")
+    finally:
+        daemon.stop()
+
+
+def test_vpn_port_registration_roundtrip(stack, monkeypatch):
+    """Gates wiring (VERDICT r1 #5): a vpn-enabled node registers the
+    algorithm's declared EXPOSED_PORTS as server Port entities before the
+    run executes, so peers can discover them mid-round."""
+    from vantage6_tpu.workloads import average as avg_mod
+
+    monkeypatch.setattr(avg_mod, "EXPOSED_PORTS", [7071], raising=False)
+    client, collab, tmp = stack["client"], stack["collab"], stack["tmp"]
+    org = client.organization.create(name="vpn_org")
+    client.collaboration.update(collab["id"], organization_ids=[org["id"]])
+    node_info = client.node.create(
+        organization_id=org["id"], collaboration_id=collab["id"]
+    )
+    daemon = NodeDaemon(
+        api_url=stack["http"].url,
+        api_key=node_info["api_key"],
+        algorithms={"v6-average-py": "vantage6_tpu.workloads.average"},
+        databases=[
+            {"label": "default", "type": "csv",
+             "uri": str(tmp / "hospital_a.csv")}
+        ],
+        mode="inline",
+        poll_interval=0.05,
+        vpn={"enabled": True},
+    )
+    daemon.start()
+    try:
+        task = client.task.create(
+            collaboration=collab["id"],
+            organizations=[org["id"]],
+            image="v6-average-py",
+            input_={"method": "partial_average", "kwargs": {"column": "age"}},
+        )
+        client.wait_for_results(task["id"], interval=0.05, timeout=30)
+        run = client.run.from_task(task["id"])[0]
+        ports = client.request("GET", "port", params={"run_id": run["id"]})[
+            "data"
+        ]
+        assert [p["port"] for p in ports] == [7071]
+        assert ports[0]["label"] == "vpn"
+    finally:
+        daemon.stop()

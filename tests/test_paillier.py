@@ -1,0 +1,139 @@
+"""Paillier correctness + masking-path parity (SURVEY.md §7 hard part 2).
+
+The load-bearing test is TestParity: the SAME quantized station vectors
+aggregated through (a) the native additive-masking path and (b) the Paillier
+path must produce IDENTICAL integers — proving the TPU-native fast path
+computes the same aggregate as the reference's classical crypto."""
+import numpy as np
+import pytest
+
+from vantage6_tpu import native
+from vantage6_tpu.common import paillier
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    return paillier.keygen(bits=512)  # small for test speed; >=2048 for real
+
+
+class TestPrimitives:
+    def test_roundtrip_signed(self, keypair):
+        pk, sk = keypair
+        for m in (0, 1, -1, 12345, -987654321, 2**40, -(2**40)):
+            assert sk.decrypt(pk.encrypt(m)) == m
+
+    def test_homomorphic_add(self, keypair):
+        pk, sk = keypair
+        c = pk.add(pk.encrypt(1111), pk.encrypt(-2222))
+        assert sk.decrypt(c) == -1111
+
+    def test_add_plain_and_mul_plain(self, keypair):
+        pk, sk = keypair
+        c = pk.encrypt(100)
+        assert sk.decrypt(pk.add_plain(c, 23)) == 123
+        assert sk.decrypt(pk.mul_plain(c, -3)) == -300
+
+    def test_ciphertexts_are_randomized(self, keypair):
+        pk, _ = keypair
+        assert pk.encrypt(42) != pk.encrypt(42)
+
+    def test_plaintext_range_enforced(self, keypair):
+        pk, _ = keypair
+        with pytest.raises(ValueError, match="outside"):
+            pk.encrypt(pk.n)
+
+    def test_bad_blinding_rejected(self, keypair):
+        pk, _ = keypair
+        with pytest.raises(ValueError, match="Z\\*_n"):
+            pk.encrypt(1, r=0)
+
+    def test_deterministic_with_fixed_r(self, keypair):
+        pk, sk = keypair
+        c1, c2 = pk.encrypt(7, r=12345), pk.encrypt(7, r=12345)
+        assert c1 == c2 and sk.decrypt(c1) == 7
+
+    def test_vector_sum(self, keypair):
+        pk, sk = keypair
+        a, b = [1, -2, 3], [10, 20, -30]
+        agg = pk.add_vectors(pk.encrypt_vector(a), pk.encrypt_vector(b))
+        assert sk.decrypt_vector(agg) == [11, 18, -27]
+
+    def test_keygen_rejects_tiny(self):
+        with pytest.raises(ValueError):
+            paillier.keygen(bits=32)
+
+
+class TestParity:
+    """masking-path aggregate == paillier-path aggregate, exactly."""
+
+    def test_secure_sum_parity(self, keypair):
+        pk, sk = keypair
+        rng = np.random.default_rng(0)
+        n_stations, dim, scale = 5, 40, 2.0**16
+        vectors = [
+            rng.normal(0, 3, dim).astype(np.float32)
+            for _ in range(n_stations)
+        ]
+
+        # (a) native additive-masking path (what nodes actually upload)
+        seed = bytes(range(32))
+        uploads = [
+            native.mask_update(seed, s, n_stations, vectors[s], scale,
+                               tag="parity-test")
+            for s in range(n_stations)
+        ]
+        masked_sum_q = native.sum_wrapping(np.stack(uploads))
+
+        # (b) paillier path on the SAME vectors
+        paillier_sum = paillier.secure_sum_paillier(pk, sk, vectors, scale)
+        paillier_sum_q = np.asarray(
+            [int(round(float(v) * scale)) for v in paillier_sum], np.int64
+        )
+
+        # identical quantized integers (int32 wrap never triggers here)
+        np.testing.assert_array_equal(
+            masked_sum_q.astype(np.int64), paillier_sum_q
+        )
+        # and both match the plain sum within quantization error
+        plain = np.sum(np.stack(vectors), axis=0)
+        np.testing.assert_allclose(
+            native.dequantize(masked_sum_q, scale), plain, atol=n_stations / scale
+        )
+
+    def test_parity_with_negative_and_zero_stations(self, keypair):
+        pk, sk = keypair
+        vectors = [
+            np.asarray([-1.5, 0.0, 2.25], np.float32),
+            np.asarray([0.0, 0.0, 0.0], np.float32),
+            np.asarray([1.5, -7.75, 0.5], np.float32),
+        ]
+        seed = b"\x07" * 32
+        scale = 2.0**12
+        uploads = [
+            native.mask_update(seed, s, 3, vectors[s], scale, tag=b"t2")
+            for s in range(3)
+        ]
+        a = native.unmask_sum(np.stack(uploads), scale)
+        b = paillier.secure_sum_paillier(pk, sk, vectors, scale)
+        np.testing.assert_array_equal(a, b)
+
+
+class TestMaskDomainSeparation:
+    """Regression (ADVICE r1): the same seed must give INDEPENDENT masks per
+    aggregation — identical uploads across two aggregations would let the
+    relay difference them and unmask."""
+
+    def test_different_tags_different_masks(self):
+        seed = bytes(32)
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        up1 = native.mask_update(seed, 0, 3, v, tag="agg-1")
+        up2 = native.mask_update(seed, 0, 3, v, tag="agg-2")
+        assert not np.array_equal(up1, up2)
+
+    def test_same_tag_still_cancels(self):
+        seed = bytes(32)
+        vs = [np.asarray([float(s)], np.float32) for s in range(4)]
+        ups = [native.mask_update(seed, s, 4, vs[s], tag="round-9")
+               for s in range(4)]
+        out = native.unmask_sum(np.stack(ups))
+        np.testing.assert_allclose(out, [6.0], atol=1e-3)

@@ -546,3 +546,107 @@ class TestSecurityRegressions:
 
         with _pytest.raises(RuntimeError, match="already bound"):
             models_mod.init("sqlite:///:memory:")
+
+
+class TestContainerJobScoping:
+    """Regression (ADVICE r1): a container token is confined to its own task
+    tree (job) — a malicious algorithm must not enumerate inputs/results of
+    other tasks in the collaboration — and to its own collaboration's
+    collaboration/node metadata."""
+
+    def _mk_task(self, seeded):
+        c = seeded["client"]
+        return c.post(
+            "/api/task",
+            {
+                "name": "avg",
+                "image": "v6-average-py",
+                "method": "partial_average",
+                "collaboration_id": seeded["collab"]["id"],
+                "organizations": [
+                    {"id": o["id"], "input": "secret-" + o["name"]}
+                    for o in seeded["orgs"]
+                ],
+            },
+        ).json
+
+    def _container(self, srv, seeded, task):
+        nc, _ = node_login(srv, seeded["api_keys"][0])
+        r = nc.post(
+            "/api/token/container",
+            {"task_id": task["id"], "image": task["image"]},
+        )
+        assert r.status == 200, r
+        cc = srv.test_client()
+        cc.token = r.json["container_token"]
+        return cc
+
+    def test_container_confined_to_own_job(self, srv, seeded):
+        t_own = self._mk_task(seeded)
+        t_other = self._mk_task(seeded)  # same collaboration, different job
+        assert t_own["job_id"] != t_other["job_id"]
+        cc = self._container(srv, seeded, t_own)
+
+        # task list: own job only
+        ids = {t["id"] for t in cc.get("/api/task").json["data"]}
+        assert t_own["id"] in ids and t_other["id"] not in ids
+        # task by id
+        assert cc.get(f"/api/task/{t_own['id']}").status == 200
+        assert cc.get(f"/api/task/{t_other['id']}").status == 403
+        # run list: no runs of the other job (whose inputs are secrets)
+        own_runs = cc.get("/api/run").json["data"]
+        other_run_ids = {
+            r["id"]
+            for r in seeded["client"]
+            .get(f"/api/task/{t_other['id']}/run")
+            .json["data"]
+        }
+        assert other_run_ids
+        assert not other_run_ids & {r["id"] for r in own_runs}
+        # runs of the other task, by task filter and by id
+        assert cc.get(f"/api/task/{t_other['id']}/run").status == 403
+        assert cc.get(f"/api/run/{next(iter(other_run_ids))}").status == 403
+
+    def test_container_subtask_stays_visible(self, srv, seeded):
+        t_own = self._mk_task(seeded)
+        cc = self._container(srv, seeded, t_own)
+        sub = cc.post(
+            "/api/task",
+            {
+                "image": "v6-average-py",
+                "method": "partial_average",
+                "collaboration_id": seeded["collab"]["id"],
+                "organizations": [{"id": seeded["orgs"][1]["id"], "input": "x"}],
+            },
+        ).json
+        assert sub["job_id"] == t_own["job_id"]
+        ids = {t["id"] for t in cc.get("/api/task").json["data"]}
+        assert sub["id"] in ids
+        assert cc.get(f"/api/task/{sub['id']}/run").status == 200
+
+    def test_container_collab_and_node_metadata_scoped(self, srv, seeded):
+        c = seeded["client"]
+        org_c = c.post("/api/organization", {"name": "hospital_c"}).json
+        collab2 = c.post(
+            "/api/collaboration",
+            {"name": "other", "organization_ids": [org_c["id"]]},
+        ).json
+        node2 = c.post(
+            "/api/node",
+            {"organization_id": org_c["id"], "collaboration_id": collab2["id"]},
+        ).json
+        t_own = self._mk_task(seeded)
+        cc = self._container(srv, seeded, t_own)
+        assert cc.get(f"/api/collaboration/{seeded['collab']['id']}").status == 200
+        assert cc.get(f"/api/collaboration/{collab2['id']}").status == 403
+        assert cc.get(f"/api/node/{seeded['nodes'][0]['id']}").status == 200
+        assert cc.get(f"/api/node/{node2['id']}").status == 403
+
+    def test_run_patch_rejects_unknown_status(self, srv, seeded):
+        """Regression (ADVICE r1): arbitrary status strings must 400, or a
+        later TaskStatus(run.status) 500s and Task.status() misclassifies."""
+        t = self._mk_task(seeded)
+        nc, _ = node_login(srv, seeded["api_keys"][0])
+        rid = nc.get(f"/api/run?task_id={t['id']}").json["data"][0]["id"]
+        assert nc.patch(f"/api/run/{rid}", {"status": "bogus"}).status == 400
+        assert nc.patch(f"/api/run/{rid}", {"status": "active"}).status == 200

@@ -224,3 +224,68 @@ class TestPolicyGate:
         finally:
             world["srv"].algorithm_policy = None
             store_http.stop()
+
+
+class TestServerStoreProxy:
+    """UI store browsing (VERDICT r1 #8): the server proxies the linked
+    store's approved registry same-origin at /api/store/algorithm."""
+
+    def test_store_info_and_browse(self):
+        from vantage6_tpu.store import models as sm
+
+        store = StoreApp()
+        sm.Algorithm(
+            name="km", image="algos/km:1.0", status="approved"
+        ).save()
+        sm.Algorithm(
+            name="wip", image="algos/wip:0.1", status="submitted"
+        ).save()
+        shttp = store.serve(port=0, background=True)
+        srv = ServerApp(store_url=shttp.url)
+        try:
+            srv.ensure_root(password="rootpass123")
+            c = srv.test_client()
+            r = c.post(
+                "/api/token/user",
+                {"username": "root", "password": "rootpass123"},
+            )
+            c.token = r.json["access_token"]
+            assert c.get("/api/store").json["url"] == shttp.url
+            algos = c.get("/api/store/algorithm").json["data"]
+            assert [a["name"] for a in algos] == ["km"]  # approved only
+            # auth required on the proxy
+            anon = srv.test_client()
+            assert anon.get("/api/store/algorithm").status == 401
+        finally:
+            srv.close()
+            shttp.stop()
+            store.close()
+
+    def test_no_store_linked_404(self):
+        srv = ServerApp()
+        try:
+            srv.ensure_root(password="rootpass123")
+            c = srv.test_client()
+            r = c.post(
+                "/api/token/user",
+                {"username": "root", "password": "rootpass123"},
+            )
+            c.token = r.json["access_token"]
+            assert c.get("/api/store").json["url"] is None
+            assert c.get("/api/store/algorithm").status == 404
+        finally:
+            srv.close()
+
+    def test_unreachable_store_502(self):
+        srv = ServerApp(store_url="http://127.0.0.1:9")  # nothing listens
+        try:
+            srv.ensure_root(password="rootpass123")
+            c = srv.test_client()
+            r = c.post(
+                "/api/token/user",
+                {"username": "root", "password": "rootpass123"},
+            )
+            c.token = r.json["access_token"]
+            assert c.get("/api/store/algorithm").status == 502
+        finally:
+            srv.close()

@@ -34,6 +34,10 @@ class AlgorithmEnvironment:
     dataframes: list[Any] = dataclasses.field(default_factory=list)
     client: Any = None  # AlgorithmClient
     metadata: RunMetadata = dataclasses.field(default_factory=RunMetadata)
+    # station-LOCAL secret (node config / federation-provisioned); basis for
+    # per-pair DH mask agreement (common.secureagg_dh) — never leaves the
+    # station, never crosses the task payload boundary
+    station_secret: bytes | None = None
 
 
 _current: contextvars.ContextVar[AlgorithmEnvironment | None] = (

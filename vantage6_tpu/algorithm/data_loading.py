@@ -13,21 +13,85 @@ silently misloading.
 from __future__ import annotations
 
 from pathlib import Path
-from typing import Any
+from typing import TYPE_CHECKING, Any
+from urllib.parse import urlparse
 
 import numpy as np
 
 from vantage6_tpu.core.config import DatabaseConfig
 
+if TYPE_CHECKING:  # pragma: no cover
+    from vantage6_tpu.node.gates import OutboundWhitelist, SSHTunnelManager
 
-def load_data(db: DatabaseConfig, data: Any = None) -> Any:
+
+def _check_egress(db: DatabaseConfig, whitelist: "OutboundWhitelist | None"):
+    """Node egress gate (reference: squid whitelist, SURVEY.md item 14).
+
+    Any database URI that names a remote host — http(s)/ftp readers or a
+    sql URL with a hostname — must pass the node's OutboundWhitelist before
+    a single byte leaves the station. Local files (csv paths, sqlite:///)
+    never hit the gate."""
+    if whitelist is None:
+        return
+    uri = db.uri or ""
+    parsed = urlparse(uri)
+    is_remote = bool(parsed.hostname) and (
+        parsed.scheme in ("http", "https", "ftp", "ftps") or db.type == "sql"
+    )
+    if is_remote and not whitelist.allows(uri):
+        raise PermissionError(
+            f"egress to {parsed.hostname!r} blocked by this node's outbound "
+            f"whitelist (database {db.label!r})"
+        )
+
+
+def _resolve_ssh_tunnel(
+    db: DatabaseConfig, tunnels: "SSHTunnelManager | None"
+) -> DatabaseConfig:
+    """Reference item 15: a db may address a named SSH tunnel endpoint
+    (``options.ssh_tunnel``). The endpoint's ``local_uri`` — the tunnel's
+    station-local end — replaces the database uri; an unknown name fails
+    loudly instead of leaking a connection attempt to the raw address."""
+    name = (db.options or {}).get("ssh_tunnel")
+    if not name:
+        return db
+    if tunnels is None:
+        raise ValueError(
+            f"database {db.label!r} wants ssh tunnel {name!r} but this node "
+            "has no ssh_tunnels configured"
+        )
+    ep = tunnels.endpoint(str(name))
+    local_uri = ep.get("local_uri")
+    if not local_uri:
+        raise ValueError(
+            f"ssh tunnel {name!r} has no local_uri configured — on this "
+            "platform the operator must point it at a station-reachable "
+            "address (no WireGuard/ssh transport exists on-pod; see "
+            "node.gates.SSHTunnelManager.reason)"
+        )
+    opts = {k: v for k, v in db.options.items() if k != "ssh_tunnel"}
+    return DatabaseConfig(
+        label=db.label, type=db.type, uri=str(local_uri), options=opts
+    )
+
+
+def load_data(
+    db: DatabaseConfig,
+    data: Any = None,
+    whitelist: "OutboundWhitelist | None" = None,
+    ssh_tunnels: "SSHTunnelManager | None" = None,
+) -> Any:
     """Load one database for one station.
 
     ``data`` short-circuits loading for programmatically supplied datasets
-    (MockAlgorithmClient-style in-memory DataFrames/arrays).
+    (MockAlgorithmClient-style in-memory DataFrames/arrays). ``whitelist``
+    and ``ssh_tunnels`` are the node's network gates (node.gates), applied
+    to remote URIs before any connection is made.
     """
     if data is not None:
         return data
+    db = _resolve_ssh_tunnel(db, ssh_tunnels)
+    _check_egress(db, whitelist)
     kind = db.type
     if kind == "csv":
         return _pandas().read_csv(db.uri, **db.options)
@@ -39,8 +103,26 @@ def load_data(db: DatabaseConfig, data: Any = None) -> Any:
         query = db.options.get("query")
         if not query:
             raise ValueError(f"sql database {db.label!r} needs options.query")
-        import sqlalchemy
+        scheme = urlparse(db.uri).scheme
+        if scheme in ("sqlite", ""):
+            # stdlib path: sqlite:///file.db or a bare file path — no
+            # sqlalchemy needed (and none ships in this image)
+            import contextlib
+            import sqlite3
 
+            path = db.uri.split("///", 1)[-1] if "///" in db.uri else db.uri
+            # closing(): sqlite3's context manager only commits, it does NOT
+            # close — a daemon loading per-run would leak one fd per run
+            with contextlib.closing(sqlite3.connect(path)) as conn:
+                return _pandas().read_sql_query(query, conn)
+        try:
+            import sqlalchemy
+        except ImportError as e:
+            raise NotImplementedError(
+                f"sql dialect {scheme!r} needs sqlalchemy, which this "
+                "environment does not ship; use sqlite:/// or install "
+                "sqlalchemy at the node"
+            ) from e
         engine = sqlalchemy.create_engine(db.uri)
         with engine.connect() as conn:
             return _pandas().read_sql(sqlalchemy.text(query), conn)

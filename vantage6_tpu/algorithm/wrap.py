@@ -62,9 +62,11 @@ def wrap_algorithm(module: ModuleType | str | None = None) -> None:
             f"method {method!r} not found in {module.__name__}"
         )
 
+    secret_hex = os.environ.get("V6T_STATION_SECRET", "")
     env = AlgorithmEnvironment(
         dataframes=_load_env_databases(),
         client=_maybe_rest_client(),
+        station_secret=bytes.fromhex(secret_hex) if secret_hex else None,
         metadata=RunMetadata(
             task_id=_int_env("TASK_ID"),
             run_id=_int_env("RUN_ID"),
@@ -94,18 +96,47 @@ def _int_env(name: str) -> int | None:
     return int(v) if v else None
 
 
+def _env_gates() -> tuple[Any, Any]:
+    """Rebuild the node's network gates from the sandbox ABI env (set by
+    TaskRunner): the sandboxed loader enforces the same egress whitelist and
+    ssh-tunnel resolution as the inline path."""
+    import json
+
+    from vantage6_tpu.node.gates import OutboundWhitelist, SSHTunnelManager
+
+    whitelist = None
+    raw = os.environ.get("V6T_EGRESS")
+    if raw:
+        whitelist = OutboundWhitelist(**json.loads(raw))
+    tunnels = None
+    raw = os.environ.get("V6T_SSH_TUNNELS")
+    if raw:
+        tunnels = SSHTunnelManager.from_config(json.loads(raw))
+    return whitelist, tunnels
+
+
 def _load_env_databases() -> list[Any]:
     labels = [
         l.strip()
         for l in os.environ.get("USER_REQUESTED_DATABASE_LABELS", "").split(",")
         if l.strip()
     ]
+    import json
+
+    whitelist, tunnels = _env_gates()
     frames = []
     for label in labels:
         key = label.upper()
         uri = os.environ.get(f"DATABASE_{key}_URI", "")
         typ = os.environ.get(f"DATABASE_{key}_TYPE", "csv")
-        frames.append(load_data(DatabaseConfig(label=label, type=typ, uri=uri)))
+        opts = json.loads(os.environ.get(f"DATABASE_{key}_OPTIONS", "") or "{}")
+        frames.append(
+            load_data(
+                DatabaseConfig(label=label, type=typ, uri=uri, options=opts),
+                whitelist=whitelist,
+                ssh_tunnels=tunnels,
+            )
+        )
     return frames
 
 

@@ -15,6 +15,8 @@ against each other and the RFC 8439 vector).
 from __future__ import annotations
 
 import ctypes
+import hashlib
+import hmac
 import os
 import subprocess
 import tempfile
@@ -126,12 +128,19 @@ def _chacha20_stream_np(key: bytes, nonce: bytes, n: int) -> np.ndarray:
     return w.reshape(-1)[:n]
 
 
-def _pair_nonce(i: int, j: int) -> bytes:
+def pair_nonce(i: int, j: int) -> bytes:
+    """The 96-bit nonce for pair (i, j): words [i, j, 0] little-endian —
+    the shared contract of the C++ kernel, the numpy fallback, and the DH
+    path (common.secureagg_dh) which reuses the keystream with per-pair
+    keys."""
     return (
         int(i).to_bytes(4, "little")
         + int(j).to_bytes(4, "little")
         + b"\x00\x00\x00\x00"
     )
+
+
+_pair_nonce = pair_nonce
 
 
 # -------------------------------------------------------------- public API
@@ -212,16 +221,43 @@ def dequantize(q: np.ndarray, scale: float) -> np.ndarray:
     return out.reshape(q.shape)
 
 
+def derive_mask_key(seed: bytes, tag: bytes | str | int) -> bytes:
+    """Per-aggregation 32-byte subkey: HMAC-SHA256(seed, context || tag).
+
+    The pairwise mask nonce is only (i, j) — it carries no round/task
+    identity — so REUSING one key across two aggregations produces
+    byte-identical masks, and the relaying server (exactly the party the
+    threat model defends against) could difference a station's two uploads
+    to cancel them and recover the quantized plaintext delta. Every
+    aggregation must therefore run under a fresh subkey; all parties derive
+    it from the provisioned long-term seed plus a shared per-aggregation
+    ``tag`` (task id, round number, …) that need not be secret.
+    """
+    if isinstance(tag, int):
+        tag = str(tag)
+    if isinstance(tag, str):
+        tag = tag.encode()
+    return hmac.new(seed, b"v6t-secureagg-mask-v1:" + tag,
+                    hashlib.sha256).digest()
+
+
 def add_pairwise_masks(
-    seed: bytes, station: int, n_stations: int, quantized: np.ndarray
+    seed: bytes,
+    station: int,
+    n_stations: int,
+    quantized: np.ndarray,
+    tag: bytes | str | int = b"",
 ) -> np.ndarray:
     """Return `quantized` plus this station's pairwise masks (mod 2^32).
 
     For each pair (i, j), i < j, station i adds +PRG, station j adds -PRG;
-    summed over all stations the masks cancel exactly.
+    summed over all stations the masks cancel exactly. The keystream key is
+    ``derive_mask_key(seed, tag)`` — pass a distinct ``tag`` per aggregation
+    (see that function for why reuse is a real unmasking attack).
     """
     if len(seed) != 32:
         raise ValueError("seed must be 32 bytes")
+    seed = derive_mask_key(seed, tag)
     q = np.ascontiguousarray(quantized, np.int32)
     dll = lib()
     if dll is not None:
@@ -282,9 +318,15 @@ def mask_update(
     n_stations: int,
     values: np.ndarray,
     scale: float = 2.0**16,
+    tag: bytes | str | int = b"",
 ) -> np.ndarray:
-    """What a node uploads: quantized values + this station's masks."""
-    return add_pairwise_masks(seed, station, n_stations, quantize(values, scale))
+    """What a node uploads: quantized values + this station's masks.
+
+    ``tag`` must be shared by all parties of ONE aggregation and differ
+    between aggregations (see derive_mask_key)."""
+    return add_pairwise_masks(
+        seed, station, n_stations, quantize(values, scale), tag=tag
+    )
 
 
 def unmask_sum(masked: np.ndarray, scale: float = 2.0**16) -> np.ndarray:

@@ -13,6 +13,12 @@
 //   - ChaCha20 (RFC 8439 block function, 20 rounds, counter from 0)
 //   - pair (i, j), i < j: 96-bit nonce = words [i, j, 0] (little-endian)
 //   - station s adds +mask(i,j) if s == i else -mask(i,j), mod 2^32
+//   - the `seed` these kernels receive is a PER-AGGREGATION subkey
+//     (HMAC-SHA256 of the provisioned long-term seed and an aggregation
+//     tag, derived host-side in derive_mask_key): the nonce carries no
+//     round identity, so a key reused across two aggregations would emit
+//     identical masks and let the relay difference two uploads to unmask
+//     them. Callers must never feed the long-term seed here directly.
 //
 // Build: g++ -O3 -shared -fPIC (no external deps).
 

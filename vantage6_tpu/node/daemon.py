@@ -21,6 +21,7 @@ from vantage6_tpu.common.rest import RestError, RestSession
 from vantage6_tpu.common.enums import TaskStatus
 from vantage6_tpu.common.log import setup_logging
 from vantage6_tpu.common.serialization import deserialize
+from vantage6_tpu.node.gates import VPNManager
 from vantage6_tpu.node.proxy import NodeProxy
 from vantage6_tpu.node.runner import (
     PolicyViolation,
@@ -45,6 +46,8 @@ class NodeDaemon:
         poll_interval: float = 0.25,
         name: str = "",
         max_concurrent_runs: int = 4,
+        station_secret: str | bytes | None = None,
+        vpn: dict[str, Any] | None = None,
     ):
         self.api_url = api_url.rstrip("/")
         self.api_key = api_key
@@ -101,7 +104,14 @@ class NodeDaemon:
             databases=databases,
             policies=policies,
             mode=mode,
+            station_secret=station_secret,
         )
+        # VPN parity (reference item 13): no WireGuard exists here — the
+        # manager's surviving job is registering algorithm-declared ports as
+        # server Port entities so iterative/MPC algorithms can discover peers
+        self.vpn = VPNManager(**(vpn or {}))
+        if self.vpn.enabled:
+            self.vpn.setup()  # logs the platform stance, returns False
         self.proxy = NodeProxy(
             server_url=self.api_url,
             cryptor=self.cryptor,
@@ -127,6 +137,8 @@ class NodeDaemon:
             ),
             mode=(cfg.get("runner", {}) or {}).get("mode", "sandbox"),
             name=ctx.name,
+            station_secret=cfg.get("station_secret") or None,
+            vpn=cfg.get("vpn") or None,
             **overrides,
         )
 
@@ -344,6 +356,20 @@ class NodeDaemon:
             )
             return
         patch(status=TaskStatus.ACTIVE.value, started_at=time.time())
+        if self.vpn.enabled:
+            # register the algorithm's declared ports (module EXPOSED_PORTS;
+            # reference: EXPOSE labels) as server Port entities before the
+            # run starts, so peer partials can look them up mid-round
+            try:
+                for p in self.runner.algorithm_ports(task["image"]):
+                    self.request(
+                        "POST",
+                        "port",
+                        {"run_id": run_id, "port": p, "label": "vpn"},
+                    )
+            except Exception as e:
+                log.warning("port registration failed for run %s: %s",
+                            run_id, e)
         try:
             # everything after ACTIVE must record its failure, or the run
             # sticks ACTIVE forever while the researcher polls
@@ -397,20 +423,31 @@ class NodeDaemon:
             # not deliver results the user cancelled
             log.info("run %s was killed mid-execution; dropping result", run_id)
             return
-        # result goes back encrypted toward the INITIATING organization
+        # result goes back encrypted toward the INITIATING organization —
+        # still inside the record-failure envelope: a missing/invalid init-org
+        # public key or a serialization error must not leave the run ACTIVE
+        # forever with the result silently lost
         from vantage6_tpu.common.serialization import serialize
 
-        init_org = task.get("init_org", {}).get("id")
-        pubkey = ""
-        if self.encrypted and init_org is not None:
-            org = self.request("GET", f"organization/{init_org}")
-            pubkey = org.get("public_key") or ""
-        blob = self.cryptor.encrypt_bytes_to_str(serialize(result), pubkey)
-        patch(
-            status=TaskStatus.COMPLETED.value,
-            result=blob,
-            finished_at=time.time(),
-        )
+        try:
+            init_org = task.get("init_org", {}).get("id")
+            pubkey = ""
+            if self.encrypted and init_org is not None:
+                org = self.request("GET", f"organization/{init_org}")
+                pubkey = org.get("public_key") or ""
+            blob = self.cryptor.encrypt_bytes_to_str(serialize(result), pubkey)
+            patch(
+                status=TaskStatus.COMPLETED.value,
+                result=blob,
+                finished_at=time.time(),
+            )
+        except Exception:
+            patch(
+                status=TaskStatus.FAILED.value,
+                log="result delivery failed: "
+                + traceback.format_exc(limit=4),
+                finished_at=time.time(),
+            )
 
     # --------------------------------------------------------------- health
     def ping(self) -> None:

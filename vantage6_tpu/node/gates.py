@@ -53,9 +53,11 @@ class VPNManager:
 class OutboundWhitelist:
     """Reference: squid proxy restricting algorithm egress (item 14).
 
-    The policy *decision* survives: `allows(url)` is consulted before any
-    host-side fetch an algorithm requests (data loading from sql/sparql
-    URIs, artifact downloads).
+    The policy *decision* survives: `allows(url)` is consulted by
+    `algorithm.data_loading.load_data` for every remote database URI, on
+    both execution paths — inline (TaskRunner.egress, built from node
+    policies.egress) and sandboxed (the V6T_EGRESS env var re-builds the
+    whitelist inside the child; see algorithm.wrap._env_gates).
     """
 
     enabled: bool = False
@@ -79,8 +81,11 @@ class OutboundWhitelist:
 @dataclass
 class SSHTunnelManager:
     """Reference: ssh tunnels from node to whitelisted internal hosts
-    (item 15). Tracked as *named endpoints* algorithms may address; actual
-    tunneling is out of scope on-pod (data is mounted/loaded directly)."""
+    (item 15). Tracked as *named endpoints* databases may address via
+    ``options.ssh_tunnel`` — `data_loading` resolves the name to the
+    endpoint's station-local ``local_uri`` (TaskRunner.ssh_tunnels inline;
+    V6T_SSH_TUNNELS over the sandbox ABI). Actual ssh transport is out of
+    scope on-pod (data is mounted/loaded directly)."""
 
     tunnels: dict[str, dict[str, Any]] = field(default_factory=dict)
     supported: bool = False

@@ -11,6 +11,7 @@ config. Policy gates (allowed algorithms, basics) match the reference's.
 """
 from __future__ import annotations
 
+import dataclasses
 import fnmatch
 import json
 import os
@@ -24,6 +25,7 @@ from typing import Any
 from vantage6_tpu.common.artifact import parse_ref
 from vantage6_tpu.common.log import setup_logging
 from vantage6_tpu.common.serialization import deserialize, serialize
+from vantage6_tpu.node.gates import OutboundWhitelist, SSHTunnelManager
 
 log = setup_logging("vantage6_tpu/node.runner")
 
@@ -59,16 +61,30 @@ class TaskRunner:
         policies: dict[str, Any] | None = None,
         mode: str = "sandbox",
         work_dir: str | Path | None = None,
+        station_secret: str | bytes | None = None,
     ):
         """``algorithms`` maps image name -> importable module path.
 
         ``databases`` is the node-config list ({label, type, uri}).
         ``mode``: "sandbox" (subprocess ABI, default — container parity) or
         "inline" (same process — fast, used by tests and trusted setups).
+        ``station_secret`` (hex str or bytes) is this station's local secret
+        for DH mask agreement (common.secureagg_dh); it is handed only to
+        the algorithm's own run environment, never uploaded.
         """
         self.algorithms = dict(algorithms or {})
         self.databases = {d["label"]: d for d in (databases or [])}
         self.policies = dict(policies or {})
+        if isinstance(station_secret, str):
+            station_secret = bytes.fromhex(station_secret)
+        self.station_secret = station_secret
+        # network gates (reference items 14/15): egress whitelist consulted
+        # on every remote data-loading URI; ssh tunnel endpoints resolved for
+        # databases that address them by name
+        self.egress = OutboundWhitelist(**(self.policies.get("egress") or {}))
+        self.ssh_tunnels = SSHTunnelManager.from_config(
+            self.policies.get("ssh_tunnels")
+        )
         if mode not in ("sandbox", "inline"):
             raise ValueError(f"unknown runner mode {mode!r}")
         self.mode = mode
@@ -104,6 +120,18 @@ class TaskRunner:
             raise UnknownAlgorithm(f"no algorithm registered for {image!r}")
         return module
 
+    def algorithm_ports(self, image: str) -> list[int]:
+        """Ports the algorithm declares for cross-station traffic — module
+        attribute ``EXPOSED_PORTS`` (reference: docker image EXPOSE labels
+        read by the VPN manager). Empty when undeclared/unresolvable."""
+        import importlib
+
+        try:
+            mod = importlib.import_module(self.resolve(image))
+        except (UnknownAlgorithm, ImportError):
+            return []
+        return [int(p) for p in getattr(mod, "EXPOSED_PORTS", []) or []]
+
     # ----------------------------------------------------------------- run
     def run(self, spec: RunSpec) -> Any:
         """Execute one run; returns the (plaintext) result object.
@@ -137,7 +165,11 @@ class TaskRunner:
                 f"method {spec.method!r} not found in {module}"
             )
         frames = [
-            load_data(DatabaseConfig(**self._db_config(d)))
+            load_data(
+                DatabaseConfig(**self._db_config(d)),
+                whitelist=self.egress,
+                ssh_tunnels=self.ssh_tunnels,
+            )
             for d in (spec.databases or [{"label": "default"}])
         ]
         client = (
@@ -155,6 +187,7 @@ class TaskRunner:
                 organization=spec.metadata.get("organization", ""),
                 collaboration=spec.metadata.get("collaboration", ""),
             ),
+            station_secret=self.station_secret,
         )
         args = spec.input_payload.get("args", []) or []
         kwargs = spec.input_payload.get("kwargs", {}) or {}
@@ -189,6 +222,16 @@ class TaskRunner:
             env["PALLAS_AXON_POOL_IPS"] = ""
         if spec.server_url:
             env["V6T_SERVER_URL"] = spec.server_url
+        if self.station_secret:
+            env["V6T_STATION_SECRET"] = self.station_secret.hex()
+        # network gates cross the ABI as JSON so the sandboxed loader
+        # enforces the same egress policy the inline path does
+        if self.egress.enabled:
+            env["V6T_EGRESS"] = json.dumps(dataclasses.asdict(self.egress))
+        if self.ssh_tunnels.tunnels:
+            env["V6T_SSH_TUNNELS"] = json.dumps(
+                list(self.ssh_tunnels.tunnels.values())
+            )
         labels = [
             d.get("label", "default")
             for d in (spec.databases or [{"label": "default"}])
@@ -198,6 +241,9 @@ class TaskRunner:
             cfg = self._db_config({"label": label})
             env[f"DATABASE_{label.upper()}_URI"] = str(cfg.get("uri", ""))
             env[f"DATABASE_{label.upper()}_TYPE"] = str(cfg.get("type", "csv"))
+            env[f"DATABASE_{label.upper()}_OPTIONS"] = json.dumps(
+                cfg.get("options", {}) or {}
+            )
         for k, v in spec.metadata.items():
             if k in ("node_id",):
                 env["NODE_ID"] = str(v)

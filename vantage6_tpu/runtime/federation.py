@@ -67,6 +67,15 @@ class Federation:
             for i, s in enumerate(config.stations)
         ]
         self._online = [True] * config.n_stations
+        # per-station LOCAL secrets (DH mask agreement, secureagg_dh):
+        # generated here exactly as each real node would generate its own;
+        # central/aggregator code has no accessor — partials reach their own
+        # station's secret through the AlgorithmEnvironment only
+        import secrets as _secrets
+
+        self._station_secrets = [
+            _secrets.token_bytes(32) for _ in range(config.n_stations)
+        ]
         # station data: per-station {label: dataset}; device-mode stacked
         # arrays cached per label.
         self._data: list[dict[str, Any]] = [{} for _ in self.stations]
@@ -308,6 +317,7 @@ class Federation:
                 organization=run.organization,
                 collaboration=self.config.name,
             ),
+            station_secret=self._station_secrets[run.station_index],
         )
         args = task.input_.get("args", []) or []
         kwargs = task.input_.get("kwargs", {}) or {}

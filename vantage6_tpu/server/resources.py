@@ -14,7 +14,7 @@ from vantage6_tpu.common.enums import TaskStatus
 from vantage6_tpu.server import events as ev
 from vantage6_tpu.server import models as m
 from vantage6_tpu.server import schemas as sch
-from vantage6_tpu.server.auth import AuthError, verify_totp
+from vantage6_tpu.server.auth import AuthError, decode_jwt, verify_totp
 from vantage6_tpu.server.permission import Operation, Scope
 from vantage6_tpu.server.web import HTTPError, Request
 
@@ -107,6 +107,23 @@ def _container_task(principal: dict[str, Any]) -> m.Task:
     return task
 
 
+def _user_for_reset_token(srv: "ServerApp", token: str) -> m.User:
+    """Resolve a password-reset token to its user; 401 on expiry, tamper,
+    or reuse (the token binds the password hash it was issued against)."""
+    # peek at the subject first so the pwh check runs against the right user
+    try:
+        sub = decode_jwt(token, srv.tokens.secret).get("sub") or {}
+        user = m.User.get(int(sub.get("id", -1))) if sub else None
+        if user is None:
+            raise AuthError("unknown user")
+        srv.tokens.validate_password_reset(
+            token, user.password_hash, user.totp_secret
+        )
+    except AuthError as e:
+        raise HTTPError(401, str(e)) from None
+    return user
+
+
 def _check_role_grant(user: m.User, role_ids: list[int]) -> list[m.Role]:
     """A grantor may only hand out roles whose rules they hold themselves —
     without this, any user-EDIT holder could self-assign Root."""
@@ -196,6 +213,81 @@ def register_resources(srv: "ServerApp") -> None:
             return srv.tokens.refresh(body["refresh_token"])
         except AuthError as e:
             raise HTTPError(401, str(e)) from None
+
+    # ------------------------------------------------------------- recovery
+    # Parity: the reference's recover.py — password reset (and 2FA reset)
+    # over emailed single-use tokens (SURVEY.md §2 item 7). Responses never
+    # reveal whether an account exists.
+    @app.route("/api/recover/lost", methods=("POST",))
+    def recover_lost(req: Request):
+        body = sch.load(sch.RecoverLostInput(), req.json)
+        user = None
+        if body.get("username"):
+            user = m.User.first(username=body["username"])
+        if user is None and body.get("email"):
+            user = m.User.first(email=body["email"])
+        if user is not None and user.email:
+            token = srv.tokens.password_reset_token(
+                user.id, user.password_hash, user.totp_secret
+            )
+            srv.mailer.send(
+                user.email,
+                "vantage6: password reset",
+                "A password reset was requested for your account "
+                f"{user.username!r}.\n\nReset token (valid "
+                f"{int(srv.tokens.RESET_TTL // 60)} minutes, single use):\n\n"
+                f"{token}\n\nIf you did not request this, ignore this mail.",
+            )
+        return {
+            "msg": "if the account exists and has an email address, a "
+            "reset token was sent"
+        }
+
+    @app.route("/api/recover/reset", methods=("POST",))
+    def recover_reset(req: Request):
+        body = sch.load(sch.RecoverResetInput(), req.json)
+        user = _user_for_reset_token(srv, body["reset_token"])
+        user.set_password(body["password"])
+        user.failed_login_attempts = 0
+        user.save()
+        return {"msg": "password updated"}
+
+    @app.route("/api/recover/2fa/lost", methods=("POST",))
+    def recover_2fa_lost(req: Request):
+        """Lost authenticator: prove password, get an emailed reset token
+        (the reference gates 2FA reset on the password the same way)."""
+        body = sch.load(sch.TokenUserInput(), req.json)
+        user = m.User.first(username=body["username"])
+        if user is not None and not user.is_locked_out():
+            if not user.check_password(body["password"]):
+                # same lockout accounting as /api/token/user — this endpoint
+                # must not be a password-guessing oracle outside the counter
+                user.record_login(False)
+            elif user.email:
+                user.record_login(True)
+                token = srv.tokens.password_reset_token(
+                    user.id, user.password_hash, user.totp_secret
+                )
+                srv.mailer.send(
+                    user.email,
+                    "vantage6: two-factor reset",
+                    f"Reset token for account {user.username!r}:\n\n{token}",
+                )
+        return {
+            "msg": "if the credentials are valid and the account has an "
+            "email address, a reset token was sent"
+        }
+
+    @app.route("/api/recover/2fa/reset", methods=("POST",))
+    def recover_2fa_reset(req: Request):
+        from vantage6_tpu.server.auth import generate_totp_secret
+
+        body = sch.load(sch.Recover2FAResetInput(), req.json)
+        user = _user_for_reset_token(srv, body["reset_token"])
+        user.totp_secret = generate_totp_secret()
+        user.save()
+        # the new secret is returned ONCE for authenticator re-enrollment
+        return {"totp_secret": user.totp_secret}
 
     # --------------------------------------------------------------- users
     @app.route("/api/user", methods=("GET", "POST"))
@@ -424,6 +516,10 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             elif kind == "node":
                 _check(principal.collaboration_id == collab.id)
+            else:  # container: its own collaboration only
+                _check(
+                    _container_task(principal).collaboration_id == collab.id
+                )
             return collab.to_dict()
         user = _require_user(srv, req)
         if req.method == "DELETE":
@@ -566,6 +662,13 @@ def register_resources(srv: "ServerApp") -> None:
                         collaboration_id=node.collaboration_id,
                     )
                 )
+            elif kind == "node":
+                _check(node.collaboration_id == principal.collaboration_id)
+            else:  # container: nodes of its own collaboration only
+                _check(
+                    node.collaboration_id
+                    == _container_task(principal).collaboration_id
+                )
             return node.to_dict()
         if kind == "node":
             # a node may PATCH its own status (online/offline heartbeat) —
@@ -621,9 +724,10 @@ def register_resources(srv: "ServerApp") -> None:
             elif kind == "node":
                 rows = m.Task.list(collaboration_id=principal.collaboration_id)
             else:
-                rows = m.Task.list(
-                    collaboration_id=_container_task(principal).collaboration_id
-                )
+                # container: its own task tree (job) only — a malicious
+                # algorithm must not enumerate other tasks' inputs/results
+                # across the collaboration
+                rows = m.Task.list(job_id=_container_task(principal).job_id)
             return _paginate(req, rows)
         return _create_task(srv, req)
 
@@ -642,11 +746,8 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             elif kind == "node":
                 _check(task.collaboration_id == principal.collaboration_id)
-            else:  # container: its own collaboration only
-                _check(
-                    task.collaboration_id
-                    == _container_task(principal).collaboration_id
-                )
+            else:  # container: its own task tree (job) only
+                _check(task.job_id == _container_task(principal).job_id)
             return task.to_dict()
         user = _require_user(srv, req)
         _check(
@@ -682,10 +783,8 @@ def register_resources(srv: "ServerApp") -> None:
                 r for r in runs if r.organization_id == principal.organization_id
             ]
         elif kind == "container":
-            _check(
-                task.collaboration_id
-                == _container_task(principal).collaboration_id
-            )
+            # own task tree (job) only, mirroring GET /api/run
+            _check(task.job_id == _container_task(principal).job_id)
         return _paginate(req, runs)
 
     @app.route("/api/kill/task", methods=("POST",))
@@ -750,13 +849,10 @@ def register_resources(srv: "ServerApp") -> None:
                 ]
         elif kind == "node":
             rows = [r for r in rows if r.organization_id == principal.organization_id]
-        else:  # container: runs of its own task tree only
-            own_collab = _container_task(principal).collaboration_id
-            rows = [
-                r
-                for r in rows
-                if m.Task.get(r.task_id).collaboration_id == own_collab
-            ]
+        else:  # container: runs of its own task tree (job) only
+            own_job = _container_task(principal).job_id
+            job_tasks = {t.id for t in m.Task.list(job_id=own_job)}
+            rows = [r for r in rows if r.task_id in job_tasks]
         return _paginate(req, rows)
 
     @app.route("/api/run/<int:id>", methods=("GET", "PATCH"))
@@ -775,11 +871,8 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             elif kind == "node":
                 _check(run.organization_id == principal.organization_id)
-            else:
-                _check(
-                    task.collaboration_id
-                    == _container_task(principal).collaboration_id
-                )
+            else:  # container: its own task tree (job) only
+                _check(task.job_id == _container_task(principal).job_id)
             return run.to_dict()
         # PATCH: only the executing node updates status/result
         node = _require_node(srv, req)
@@ -917,6 +1010,40 @@ def register_resources(srv: "ServerApp") -> None:
         _check(run.organization_id == node.organization_id)
         port = m.Port(**body).save()
         return port.to_dict(), 201
+
+    # ----------------------------------------------------------------- store
+    @app.route("/api/store", methods=("GET",))
+    def store_info(req: Request):
+        """The linked algorithm store, if any (UI + clients discover it
+        here instead of each needing their own store config)."""
+        _identity(srv, req)
+        return {"url": srv.store_url}
+
+    @app.route("/api/store/algorithm", methods=("GET",))
+    def store_algorithms(req: Request):
+        """Same-origin proxy to the linked store's public (approved)
+        algorithm registry, so the browser UI can browse the store without
+        cross-origin requests or separate store credentials."""
+        _identity(srv, req)
+        if not srv.store_url:
+            raise HTTPError(404, "no algorithm store linked to this server")
+        import requests
+
+        try:
+            resp = requests.get(
+                f"{srv.store_url}/api/algorithm",
+                params={
+                    k: req.arg(k)
+                    for k in ("status", "name")
+                    if req.arg(k) is not None
+                },
+                timeout=10,
+            )
+        except requests.RequestException as e:
+            raise HTTPError(502, f"store unreachable: {e}") from None
+        if resp.status_code != 200:
+            raise HTTPError(502, f"store error {resp.status_code}")
+        return resp.json()
 
     # --------------------------------------------------------------- events
     @app.route("/api/event", methods=("GET",))

@@ -11,6 +11,7 @@ from typing import Any
 
 from marshmallow import EXCLUDE, Schema, ValidationError, fields, validate
 
+from vantage6_tpu.common.enums import TaskStatus
 from vantage6_tpu.server.web import HTTPError
 
 
@@ -36,6 +37,20 @@ class TokenContainerInput(_Base):
 
 class RefreshInput(_Base):
     refresh_token = fields.Str(required=True)
+
+
+class RecoverLostInput(_Base):
+    username = fields.Str(load_default=None)
+    email = fields.Email(load_default=None)
+
+
+class RecoverResetInput(_Base):
+    reset_token = fields.Str(required=True)
+    password = fields.Str(required=True, validate=validate.Length(min=8))
+
+
+class Recover2FAResetInput(_Base):
+    reset_token = fields.Str(required=True)
 
 
 class UserInput(_Base):
@@ -114,7 +129,12 @@ class TaskInput(_Base):
 
 
 class RunPatch(_Base):
-    status = fields.Str(load_default=None)
+    # a free-form status would later make TaskStatus(run.status) raise (500)
+    # and Task.status() misclassify the run — reject it at the boundary
+    status = fields.Str(
+        load_default=None,
+        validate=validate.OneOf([s.value for s in TaskStatus]),
+    )
     result = fields.Str(load_default=None)
     log = fields.Str(load_default=None)
     started_at = fields.Float(load_default=None)

@@ -38,6 +38,7 @@ def partial_secure_average(
     n_parties: int,
     scale: float,
     max_abs: float,
+    agg_tag: str = "",
 ) -> dict[str, Any]:
     """Upload = masked [sum, count]; plaintext never leaves the station.
 
@@ -55,7 +56,8 @@ def partial_secure_average(
         max_abs,
     )
     masked = native.mask_update(
-        bytes.fromhex(seed_hex), party_index, n_parties, vec, scale
+        bytes.fromhex(seed_hex), party_index, n_parties, vec, scale,
+        tag=agg_tag,
     )
     return {"masked": masked, "party_index": party_index}
 
@@ -81,6 +83,8 @@ def central_secure_average(
     """
     from vantage6_tpu import native
 
+    import secrets
+
     orgs = organizations or [o["id"] for o in client.organization.list()]
     n = len(orgs)
     if n < 2:
@@ -89,6 +93,10 @@ def central_secure_average(
             "would be trivially unmaskable by the seed holder)"
         )
     scale = 2.0**30 / (n * max_abs)
+    # fresh per-aggregation tag: mask keystreams must never repeat across
+    # aggregations under one provisioned seed (native.derive_mask_key) —
+    # the tag is not secret, it only provides domain separation
+    agg_tag = secrets.token_hex(16)
     # one subtask per org: each party must learn its own party_index
     uploads = []
     subtasks = []
@@ -104,6 +112,7 @@ def central_secure_average(
                         "n_parties": n,
                         "scale": scale,
                         "max_abs": max_abs,
+                        "agg_tag": agg_tag,
                     },
                 },
                 organizations=[org],
@@ -114,6 +123,140 @@ def central_secure_average(
         result = client.wait_for_results(task_id=sub["id"])[0]
         uploads.append(np.asarray(result["masked"], np.int32))
     total = native.unmask_sum(np.stack(uploads), scale)
+    g_sum, g_count = float(total[0]), float(total[1])
+    return {
+        "average": g_sum / g_count if g_count else float("nan"),
+        "count": int(round(g_count)),
+    }
+
+
+# --------------------------------------------------------------------------
+# Untrusted-aggregator variant: per-pair X25519 DH mask agreement
+# (common.secureagg_dh; Bonawitz et al. CCS'17 key provisioning). Two task
+# rounds: stations advertise per-aggregation public keys through the server,
+# then upload masked vectors whose pairwise masks only the two endpoint
+# stations can compute — the aggregator, holding every pubkey and every
+# upload, cannot unmask anyone.
+# --------------------------------------------------------------------------
+
+
+def partial_advertise_mask_key(party_index: int, agg_tag: str) -> dict[str, Any]:
+    """Round 1: publish this station's per-aggregation X25519 public key.
+
+    The keypair derives deterministically from the station-LOCAL secret and
+    the tag, so round 2 re-derives the same private key with no state."""
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    _, pub_hex = dh.derive_keypair(dh.get_station_secret(), agg_tag)
+    return {"party_index": party_index, "pubkey": pub_hex}
+
+
+@data(1)
+def partial_secure_average_dh(
+    df: Any,
+    column: str,
+    party_index: int,
+    pubkeys: list[list[Any]],
+    scale: float,
+    max_abs: float,
+    agg_tag: str,
+) -> dict[str, Any]:
+    """Round 2: upload = DH-masked [sum, count]; same clipping contract as
+    the single-seed variant. ``pubkeys`` is [[party_index, pub_hex], ...]
+    for ALL parties (wire-safe pair list; JSON would stringify int keys)."""
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    col = df[column]
+    vec = np.clip(
+        np.asarray([col.sum(), float(col.count())], np.float32),
+        -max_abs,
+        max_abs,
+    )
+    masked = dh.mask_update_dh(
+        dh.get_station_secret(),
+        party_index,
+        {int(i): p for i, p in pubkeys},
+        vec,
+        scale,
+        tag=agg_tag,
+    )
+    return {"masked": masked, "party_index": party_index}
+
+
+@algorithm_client
+def central_secure_average_dh(
+    client: Any,
+    column: str,
+    organizations: list[int] | None = None,
+    max_abs: float = 2.0**24,
+) -> dict[str, Any]:
+    """Secure average with NO shared seed: this central function (and an
+    honest-but-curious server relaying everything) sees only public keys
+    and masked uploads and cannot reconstruct an individual station's
+    [sum, count]. An ACTIVE malicious server could substitute relayed
+    pubkeys (see common.secureagg_dh scope notes) — signing adverts with
+    org identity keys is the planned hardening.
+
+    No dropout recovery: every advertiser must upload (see secureagg_dh) —
+    a missing upload leaves masks uncancelled and the round is retried.
+    """
+    import secrets
+
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    n = len(orgs)
+    if n < 2:
+        raise ValueError(
+            "secure aggregation needs >= 2 parties (a single masked upload "
+            "has no pairwise masks at all)"
+        )
+    scale = 2.0**30 / (n * max_abs)
+    agg_tag = secrets.token_hex(16)
+
+    # round 1: collect per-aggregation public keys
+    adverts = []
+    for idx, org in enumerate(orgs):
+        adverts.append(
+            client.task.create(
+                input_={
+                    "method": "partial_advertise_mask_key",
+                    "kwargs": {"party_index": idx, "agg_tag": agg_tag},
+                },
+                organizations=[org],
+                name=f"dh_advertise_{idx}",
+            )
+        )
+    pubkeys: list[list[Any]] = []
+    for sub in adverts:
+        r = client.wait_for_results(task_id=sub["id"])[0]
+        pubkeys.append([int(r["party_index"]), r["pubkey"]])
+
+    # round 2: masked uploads under the advertised keys
+    subtasks = []
+    for idx, org in enumerate(orgs):
+        subtasks.append(
+            client.task.create(
+                input_={
+                    "method": "partial_secure_average_dh",
+                    "kwargs": {
+                        "column": column,
+                        "party_index": idx,
+                        "pubkeys": pubkeys,
+                        "scale": scale,
+                        "max_abs": max_abs,
+                        "agg_tag": agg_tag,
+                    },
+                },
+                organizations=[org],
+                name=f"dh_secure_partial_{idx}",
+            )
+        )
+    uploads = []
+    for sub in subtasks:
+        result = client.wait_for_results(task_id=sub["id"])[0]
+        uploads.append(np.asarray(result["masked"], np.int32))
+    total = dh.unmask_sum_dh(np.stack(uploads), scale)
     g_sum, g_count = float(total[0]), float(total[1])
     return {
         "average": g_sum / g_count if g_count else float("nan"),
