@@ -1,0 +1,110 @@
+"""Web UI (SURVEY.md §2 item 27): the buildless SPA now carries the
+admin surface (organizations / users / roles CRUD) and store browsing —
+served markup + every API endpoint the page's JS calls."""
+import re
+
+import pytest
+
+from vantage6_tpu.server.app import ServerApp
+
+
+@pytest.fixture()
+def srv():
+    app = ServerApp()
+    app.ensure_root(password="rootpass123")
+    yield app
+    app.close()
+
+
+def _login(srv):
+    c = srv.test_client()
+    r = c.post("/api/token/user", {"username": "root", "password": "rootpass123"})
+    c.token = r.json["access_token"]
+    return c
+
+
+class TestPage:
+    def test_admin_and_store_markup_present(self, srv):
+        page = srv.test_client().get("/").body.decode()
+        for anchor in (
+            'id="tab_admin"', 'id="tab_store"', 'id="a_orgs"', 'id="a_users"',
+            'id="a_roles"', 'id="u_create"', 'id="r_create"', 'id="o_create"',
+            'id="s_algos"', "data-tab=", "refreshAdmin", "refreshStore",
+        ):
+            assert anchor in page, anchor
+
+    def test_every_js_api_endpoint_exists(self, srv):
+        """Each `api("METHOD", "path")` call in the page resolves to a live
+        route — markup can't drift ahead of the API."""
+        page = srv.test_client().get("/").body.decode()
+        c = _login(srv)
+        calls = set(re.findall(r'api\("(GET|POST|DELETE)",\s*[`"]([\w/?=&]+)', page))
+        assert len(calls) >= 8
+        for method, path in calls:
+            path = path.split("?")[0]
+            if method != "GET" or path.endswith("/"):
+                continue  # mutating calls need bodies, dynamic segments
+                # (`task/${id}`) truncate at the interpolation — GETs on
+                # static paths prove the routing
+            if path == "store/algorithm":
+                continue  # legitimately 404s when no store is linked
+                # (covered by test_store.TestServerStoreProxy)
+            r = c.get("/api/" + path)
+            assert r.status != 404, (method, path, r.status)
+
+
+class TestAdminScreensAPI:
+    """The endpoints behind each admin screen, exercised as the UI uses
+    them (these are the screens' API contracts)."""
+
+    def test_organization_screen(self, srv):
+        c = _login(srv)
+        r = c.post("/api/organization", {"name": "ui_org", "country": "nl"})
+        assert r.status == 201
+        rows = c.get("/api/organization").json["data"]
+        assert any(
+            o["name"] == "ui_org" and o["country"] == "nl" for o in rows
+        )
+
+    def test_user_screen_create_list_delete(self, srv):
+        c = _login(srv)
+        org = c.post("/api/organization", {"name": "u_org"}).json
+        role = next(
+            r for r in c.get("/api/role").json["data"]
+            if r["name"] == "Researcher"
+        )
+        made = c.post(
+            "/api/user",
+            {
+                "username": "ui_user",
+                "password": "uiuserpass12",
+                "email": "ui@example.org",
+                "organization_id": org["id"],
+                "roles": [role["id"]],
+            },
+        )
+        assert made.status == 201
+        rows = c.get("/api/user").json["data"]
+        row = next(u for u in rows if u["username"] == "ui_user")
+        assert row["roles"] == [role["id"]]
+        assert c.open("DELETE", f"/api/user/{row['id']}").status == 204
+        assert not any(
+            u["username"] == "ui_user"
+            for u in c.get("/api/user").json["data"]
+        )
+
+    def test_role_screen_create_with_rules(self, srv):
+        c = _login(srv)
+        rules = c.get("/api/rule?per_page=500").json["data"]
+        pick = [r["id"] for r in rules if r["name"] == "task"][:2]
+        assert pick
+        made = c.post(
+            "/api/role",
+            {"name": "ui_role", "organization_id": None, "rules": pick},
+        )
+        assert made.status == 201
+        got = next(
+            r for r in c.get("/api/role").json["data"]
+            if r["name"] == "ui_role"
+        )
+        assert sorted(got["rules"]) == sorted(pick)

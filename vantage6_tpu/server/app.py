@@ -27,6 +27,8 @@ class ServerApp:
         uri: str = "sqlite:///:memory:",
         jwt_secret: str | None = None,
         algorithm_policy: Callable[[str], bool] | None = None,
+        mailer: Any = None,
+        store_url: str | None = None,
     ):
         self.started_at = time.time()
         self.db = models.init(uri)
@@ -34,9 +36,17 @@ class ServerApp:
         self.default_roles = self.pm.ensure_default_roles()
         self.tokens = TokenAuthority(jwt_secret)
         self.hub = EventHub()
+        # account recovery mail (reference: SMTP; pluggable here — the
+        # default LogMailer records messages for dev/test deployments)
+        from vantage6_tpu.server.mail import LogMailer
+
+        self.mailer = mailer or LogMailer()
         # optional algorithm-store gate: image -> allowed? (SURVEY §2 item 9;
         # wired up by the store service or a static allow-list)
         self.algorithm_policy = algorithm_policy
+        # linked algorithm store (SURVEY §2 item 9); the UI browses it
+        # through the server-side proxy at /api/store/algorithm
+        self.store_url = store_url.rstrip("/") if store_url else None
         self.ws_url: str | None = None  # set by an attached WebSocketBridge
         self._bridges: list[Any] = []  # stopped in close()
         self.app = App("vantage6_tpu-server")
@@ -107,8 +117,12 @@ class ServerApp:
 
 def run_server(ctx: ServerContext, background: bool = False) -> AppServer:
     """Start a server from an instance context (reference: `v6 server start`)."""
+    from vantage6_tpu.server.mail import mailer_from_config
+
     srv = ServerApp(
-        uri=ctx.uri, jwt_secret=ctx.config.get("jwt_secret") or None
+        uri=ctx.uri,
+        jwt_secret=ctx.config.get("jwt_secret") or None,
+        mailer=mailer_from_config(ctx.config.get("smtp")),
     )
     user, generated = srv.ensure_root()
     if generated:

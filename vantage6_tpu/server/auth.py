@@ -131,6 +131,56 @@ class TokenAuthority:
         }
         return self._mint({"sub": sub, "use": "access"}, self.ACCESS_TTL)
 
+    # -------------------------------------------------------- password reset
+    RESET_TTL = 3600.0
+
+    @staticmethod
+    def _credential_fingerprint(
+        password_hash: str | None, totp_secret: str | None
+    ) -> str:
+        """Fingerprint of BOTH credentials: a reset token dies the moment
+        either the password or the TOTP secret changes — so one token can
+        perform exactly one reset (password OR 2FA), never be replayed."""
+        material = (password_hash or "") + ":" + (totp_secret or "")
+        return hashlib.sha256(material.encode()).hexdigest()[:16]
+
+    def password_reset_token(
+        self, user_id: int, password_hash: str | None,
+        totp_secret: str | None = None,
+    ) -> str:
+        """Single-use-by-construction reset token — stateless revocation via
+        the credential fingerprint (see _credential_fingerprint)."""
+        return self._mint(
+            {
+                "sub": {"type": "user", "id": user_id},
+                "use": "password_reset",
+                "pwh": self._credential_fingerprint(
+                    password_hash, totp_secret
+                ),
+            },
+            self.RESET_TTL,
+        )
+
+    def validate_password_reset(
+        self, token: str, current_password_hash: str | None,
+        current_totp_secret: str | None = None,
+    ) -> int:
+        """Returns the user id; raises AuthError on any mismatch."""
+        claims = decode_jwt(token, self.secret)
+        if claims.get("use") != "password_reset":
+            raise AuthError("not a password reset token")
+        if not hmac.compare_digest(
+            claims.get("pwh", ""),
+            self._credential_fingerprint(
+                current_password_hash, current_totp_secret
+            ),
+        ):
+            raise AuthError("reset token already used or superseded")
+        sub = claims.get("sub") or {}
+        if sub.get("type") != "user" or "id" not in sub:
+            raise AuthError("malformed subject")
+        return int(sub["id"])
+
     # ------------------------------------------------------------ validation
     def identity(self, token: str, use: str = "access") -> dict[str, Any]:
         claims = decode_jwt(token, self.secret)

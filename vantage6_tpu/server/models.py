@@ -437,4 +437,9 @@ def init(uri: str = "sqlite:///:memory:", replace: bool = False) -> Database:
         m.ensure_schema()
     for link in ALL_LINKS:
         link.ensure_schema()
+    # versioned upgrades on top of the additive DDL (constraints, backfills,
+    # indexes — recorded in schema_version; see server.migrations)
+    from vantage6_tpu.server import migrations
+
+    migrations.migrate(db)
     return db

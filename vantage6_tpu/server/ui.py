@@ -81,6 +81,13 @@ a { color:var(--accent); cursor:pointer; }
   </div>
 
   <div id="appview" class="hidden">
+    <nav class="row" style="margin-bottom:1rem">
+      <button class="tabbtn" data-tab="overview">Overview</button>
+      <button class="tabbtn ghost" data-tab="admin">Admin</button>
+      <button class="tabbtn ghost" data-tab="store">Store</button>
+    </nav>
+
+    <div id="tab_overview">
     <div class="panel">
       <h2>Nodes</h2>
       <table id="nodes"><thead><tr>
@@ -117,6 +124,63 @@ a { color:var(--accent); cursor:pointer; }
         <th>run</th><th>organization</th><th>status</th><th>result / log</th>
       </tr></thead><tbody></tbody></table>
     </div>
+    </div><!-- /tab_overview -->
+
+    <div id="tab_admin" class="hidden">
+    <div class="panel">
+      <h2>Organizations</h2>
+      <table id="a_orgs"><thead><tr>
+        <th>id</th><th>name</th><th>country</th><th>public key</th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.6rem">
+        <input id="o_name" placeholder="new organization name" size="24">
+        <input id="o_country" placeholder="country" size="12">
+        <button id="o_create">Create organization</button>
+      </div>
+      <div id="orgerr" class="err"></div>
+    </div>
+    <div class="panel">
+      <h2>Users</h2>
+      <table id="a_users"><thead><tr>
+        <th>id</th><th>username</th><th>email</th><th>organization</th>
+        <th>roles</th><th></th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.6rem">
+        <input id="u_name" placeholder="username" size="14">
+        <input id="u_pass" type="password" placeholder="password" size="14">
+        <input id="u_email" placeholder="email" size="18">
+        <select id="u_org"></select>
+        <select id="u_roles" multiple size="3"
+                title="roles (ctrl-click for several)"></select>
+        <button id="u_create">Create user</button>
+      </div>
+      <div id="usererr" class="err"></div>
+    </div>
+    <div class="panel">
+      <h2>Roles</h2>
+      <table id="a_roles"><thead><tr>
+        <th>id</th><th>name</th><th>organization</th><th>rules</th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.6rem">
+        <input id="r_name" placeholder="role name" size="16">
+        <select id="r_org"><option value="">global</option></select>
+        <select id="r_rules" multiple size="4"
+                title="rules (ctrl-click for several)"></select>
+        <button id="r_create">Create role</button>
+      </div>
+      <div id="roleerr" class="err"></div>
+    </div>
+    </div><!-- /tab_admin -->
+
+    <div id="tab_store" class="hidden">
+    <div class="panel">
+      <h2>Algorithm store <span id="s_url" class="who"></span></h2>
+      <table id="s_algos"><thead><tr>
+        <th>id</th><th>name</th><th>image</th><th>status</th><th>functions</th>
+      </tr></thead><tbody></tbody></table>
+      <div id="storeerr" class="err"></div>
+    </div>
+    </div><!-- /tab_store -->
   </div>
 </main>
 <script>
@@ -183,6 +247,119 @@ window.showTask = async function (id) {
     `<td>${badge(r.status)}</td>` +
     `<td><pre>${esc((r.result || r.log || "").slice(0, 400))}</pre></td></tr>`);
 };
+
+// ------------------------------------------------------------------- tabs
+let activeTab = "overview";
+document.querySelectorAll(".tabbtn").forEach((b) => {
+  b.onclick = () => switchTab(b.dataset.tab);
+});
+function switchTab(tab) {
+  activeTab = tab;
+  for (const t of ["overview", "admin", "store"]) {
+    $("tab_" + t).classList.toggle("hidden", t !== tab);
+    document.querySelector(`.tabbtn[data-tab=${t}]`)
+      .classList.toggle("ghost", t !== tab);
+  }
+  if (tab === "admin") refreshAdmin().catch(() => {});
+  if (tab === "store") refreshStore().catch(() => {});
+}
+
+// ------------------------------------------------------------------ admin
+async function refreshAdmin() {
+  const [orgs, users, roles, rules] = await Promise.all([
+    api("GET", "organization"), api("GET", "user"),
+    api("GET", "role"), api("GET", "rule?per_page=500"),
+  ]);
+  fill("a_orgs", orgs.data, (o) =>
+    `<tr><td>${Number(o.id)}</td><td>${esc(o.name)}</td>` +
+    `<td>${esc(o.country || "")}</td>` +
+    `<td>${o.public_key ? "yes" : "—"}</td></tr>`);
+  const roleName = Object.fromEntries(roles.data.map((r) => [r.id, r.name]));
+  fill("a_users", users.data, (u) =>
+    `<tr><td>${Number(u.id)}</td><td>${esc(u.username)}</td>` +
+    `<td>${esc(u.email || "")}</td><td>${esc(u.organization.id)}</td>` +
+    `<td>${esc((u.roles || []).map((r) => roleName[r] || r).join(", "))}</td>` +
+    `<td><button class="ghost" onclick="deleteUser(${Number(u.id)})">` +
+    `delete</button></td></tr>`);
+  fill("a_roles", roles.data, (r) =>
+    `<tr><td>${Number(r.id)}</td><td>${esc(r.name)}</td>` +
+    `<td>${esc(r.organization ? r.organization.id : "global")}</td>` +
+    `<td>${Number((r.rules || []).length)}</td></tr>`);
+  const orgOpts = orgs.data.map(
+    (o) => `<option value="${Number(o.id)}">${esc(o.name)}</option>`).join("");
+  $("u_org").innerHTML = orgOpts;
+  $("r_org").innerHTML = `<option value="">global</option>` + orgOpts;
+  $("u_roles").innerHTML = roles.data.map(
+    (r) => `<option value="${Number(r.id)}">${esc(r.name)}</option>`).join("");
+  $("r_rules").innerHTML = rules.data.map((r) =>
+    `<option value="${Number(r.id)}">` +
+    `${esc(r.name)}:${esc(r.scope)}:${esc(r.operation)}</option>`).join("");
+}
+
+window.deleteUser = async function (id) {
+  try { await api("DELETE", `user/${id}`); await refreshAdmin(); }
+  catch (e) { $("usererr").textContent = e.message; }
+};
+
+const selected = (id) =>
+  [...$(id).selectedOptions].map((o) => parseInt(o.value, 10));
+
+$("o_create").onclick = async () => {
+  try {
+    $("orgerr").textContent = "";
+    await api("POST", "organization",
+      { name: $("o_name").value, country: $("o_country").value });
+    $("o_name").value = "";
+    await refreshAdmin();
+  } catch (e) { $("orgerr").textContent = e.message; }
+};
+
+$("u_create").onclick = async () => {
+  try {
+    $("usererr").textContent = "";
+    await api("POST", "user", {
+      username: $("u_name").value, password: $("u_pass").value,
+      email: $("u_email").value || null,
+      organization_id: parseInt($("u_org").value, 10),
+      roles: selected("u_roles"),
+    });
+    $("u_name").value = ""; $("u_pass").value = "";
+    await refreshAdmin();
+  } catch (e) { $("usererr").textContent = e.message; }
+};
+
+$("r_create").onclick = async () => {
+  try {
+    $("roleerr").textContent = "";
+    await api("POST", "role", {
+      name: $("r_name").value,
+      organization_id: $("r_org").value ?
+        parseInt($("r_org").value, 10) : null,
+      rules: selected("r_rules"),
+    });
+    $("r_name").value = "";
+    await refreshAdmin();
+  } catch (e) { $("roleerr").textContent = e.message; }
+};
+
+// ------------------------------------------------------------------ store
+async function refreshStore() {
+  $("storeerr").textContent = "";
+  const info = await api("GET", "store");
+  if (!info.url) {
+    $("s_url").textContent = "(no store linked)";
+    fill("s_algos", [], () => ""); return;
+  }
+  $("s_url").textContent = info.url;
+  try {
+    const algos = await api("GET", "store/algorithm");
+    fill("s_algos", algos.data, (a) =>
+      `<tr><td>${Number(a.id)}</td><td>${esc(a.name)}</td>` +
+      `<td>${esc(a.image)}</td><td>${badge(a.status)}</td>` +
+      `<td>${esc((a.functions || []).map((f) => f.name).join(", "))}</td>` +
+      `</tr>`);
+  } catch (e) { $("storeerr").textContent = e.message; }
+}
 
 async function enter() {
   $("login").classList.add("hidden");

@@ -13,6 +13,11 @@ standard FedAvg heterogeneity knob).
 """
 from __future__ import annotations
 
+import gzip
+import os
+import struct
+from pathlib import Path
+
 import numpy as np
 
 
@@ -41,6 +46,83 @@ def synthetic_image_classes(
         np.float32
     )
     return x, labels
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    """IDX (LeCun MNIST format) reader — magic 0x0801 (labels) / 0x0803
+    (images); transparently decompresses .gz."""
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:  # type: ignore[operator]
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise ValueError(f"{path}: not an IDX file")
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        if dtype_code != 0x08:  # ubyte, the only type MNIST uses
+            raise ValueError(f"{path}: unsupported IDX dtype {dtype_code:#x}")
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(dims)
+
+
+def load_mnist(
+    data_dir: str | Path | None = None, split: str = "train"
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Real MNIST from a local directory, if present; else None.
+
+    Makes BASELINE.md's accuracy-parity criterion measurable the moment the
+    files exist (no network in this image, so they must be provided). The
+    directory — ``data_dir`` arg, else $V6T_MNIST_DIR, else ./data/mnist —
+    may hold either:
+      - ``mnist.npz`` with arrays x_train/y_train/x_test/y_test (keras
+        layout), or
+      - the classic IDX pair ``train-images-idx3-ubyte[.gz]`` +
+        ``train-labels-idx1-ubyte[.gz]`` (and t10k-* for split="test").
+
+    Returns (x [n,28,28,1] float32 in [0,1], y [n] int32), or None when
+    nothing is found — callers fall back to the synthetic generator.
+    """
+    root = Path(
+        data_dir
+        or os.environ.get("V6T_MNIST_DIR", "")
+        or Path("data") / "mnist"
+    )
+    npz = root / "mnist.npz"
+    if npz.exists():
+        with np.load(npz) as z:
+            x = z[f"x_{split}"]
+            y = z[f"y_{split}"]
+    else:
+        prefix = "train" if split == "train" else "t10k"
+        images = labels = None
+        for suffix in ("", ".gz"):
+            ip = root / f"{prefix}-images-idx3-ubyte{suffix}"
+            lp = root / f"{prefix}-labels-idx1-ubyte{suffix}"
+            if ip.exists() and lp.exists():
+                images, labels = ip, lp
+                break
+        if images is None:
+            return None
+        x = _read_idx(images)
+        y = _read_idx(labels)
+    x = np.asarray(x, np.float32) / 255.0
+    if x.ndim == 3:
+        x = x[..., None]
+    return x, np.asarray(y, np.int32)
+
+
+def image_classes(
+    n: int, *, seed: int = 0, data_dir: str | Path | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """n MNIST-shaped examples: REAL MNIST when a local copy exists
+    (sampled with `seed`), synthetic templates otherwise — the single entry
+    point workloads/benchmarks use."""
+    real = load_mnist(data_dir)
+    if real is None:
+        return synthetic_image_classes(n, seed=seed)
+    x, y = real
+    idx = np.random.default_rng(seed).choice(
+        len(x), size=n, replace=n > len(x)
+    )
+    return x[idx], y[idx]
 
 
 def synthetic_tabular(

@@ -25,6 +25,7 @@ from vantage6_tpu.fed.collectives import fed_mean
 from vantage6_tpu.fed.fedavg import FedAvg, FedAvgSpec
 from vantage6_tpu.models.cnn import CNN, accuracy, cross_entropy_loss
 from vantage6_tpu.utils.datasets import (
+    image_classes,
     partition_dirichlet,
     pad_shards,
     synthetic_image_classes,
@@ -72,9 +73,10 @@ def make_federated_data(
     seed: int = 0,
     mesh: FederationMesh | None = None,
 ):
-    """Synthetic MNIST-shaped data, Dirichlet non-iid across stations,
-    padded + stacked (+ sharded when a mesh is given)."""
-    x, y = synthetic_image_classes(n_stations * n_per_station, seed=seed)
+    """MNIST-shaped data (REAL MNIST when a local copy exists — see
+    utils.datasets.load_mnist — synthetic templates otherwise), Dirichlet
+    non-iid across stations, padded + stacked (+ sharded with a mesh)."""
+    x, y = image_classes(n_stations * n_per_station, seed=seed)
     shards = partition_dirichlet(x, y, n_stations, alpha=alpha, seed=seed)
     sx, sy, counts = pad_shards(shards)
     if mesh is not None:
