@@ -6,9 +6,10 @@ omop — each yielding a pandas DataFrame for ``@data`` injection. Added here:
 ``array`` (npy/npz or in-memory) for the TPU fast path, where a station's
 shard is a jax-ready array pytree rather than a DataFrame.
 
-sparql/omop need packages this image doesn't ship (SPARQLWrapper /
-pyarrow-omop tooling); they raise a clear error naming the gap instead of
-silently misloading.
+sparql speaks plain HTTP (application/sparql-results+json) so it needs no
+SPARQLWrapper; omop treats the CDM as the SQL database it is (marker-table
+check + query). Non-sqlite SQL dialects need sqlalchemy at the node (not in
+this image) and say so explicitly.
 """
 from __future__ import annotations
 
@@ -36,7 +37,8 @@ def _check_egress(db: DatabaseConfig, whitelist: "OutboundWhitelist | None"):
     uri = db.uri or ""
     parsed = urlparse(uri)
     is_remote = bool(parsed.hostname) and (
-        parsed.scheme in ("http", "https", "ftp", "ftps") or db.type == "sql"
+        parsed.scheme in ("http", "https", "ftp", "ftps")
+        or db.type in ("sql", "omop", "sparql")
     )
     if is_remote and not whitelist.allows(uri):
         raise PermissionError(
@@ -100,32 +102,7 @@ def load_data(
     if kind == "excel":
         return _pandas().read_excel(db.uri, **db.options)
     if kind == "sql":
-        query = db.options.get("query")
-        if not query:
-            raise ValueError(f"sql database {db.label!r} needs options.query")
-        scheme = urlparse(db.uri).scheme
-        if scheme in ("sqlite", ""):
-            # stdlib path: sqlite:///file.db or a bare file path — no
-            # sqlalchemy needed (and none ships in this image)
-            import contextlib
-            import sqlite3
-
-            path = db.uri.split("///", 1)[-1] if "///" in db.uri else db.uri
-            # closing(): sqlite3's context manager only commits, it does NOT
-            # close — a daemon loading per-run would leak one fd per run
-            with contextlib.closing(sqlite3.connect(path)) as conn:
-                return _pandas().read_sql_query(query, conn)
-        try:
-            import sqlalchemy
-        except ImportError as e:
-            raise NotImplementedError(
-                f"sql dialect {scheme!r} needs sqlalchemy, which this "
-                "environment does not ship; use sqlite:/// or install "
-                "sqlalchemy at the node"
-            ) from e
-        engine = sqlalchemy.create_engine(db.uri)
-        with engine.connect() as conn:
-            return _pandas().read_sql(sqlalchemy.text(query), conn)
+        return _load_sql(db)
     if kind == "array":
         if not db.uri:
             raise ValueError(
@@ -136,13 +113,105 @@ def load_data(
             with np.load(p) as z:
                 return {k: z[k] for k in z.files}
         return np.load(p)
-    if kind in ("sparql", "omop"):
-        raise NotImplementedError(
-            f"database type {kind!r} requires packages not present in this "
-            "environment (SPARQLWrapper / OMOP tooling); supply a DataFrame "
-            "directly or use csv/parquet/sql"
-        )
+    if kind == "sparql":
+        return _load_sparql(db)
+    if kind == "omop":
+        return _load_omop(db)
     raise ValueError(f"unknown database type {kind!r}")
+
+
+def _load_sql(db: DatabaseConfig) -> Any:
+    query = db.options.get("query")
+    if not query:
+        raise ValueError(f"sql database {db.label!r} needs options.query")
+    scheme = urlparse(db.uri).scheme
+    if scheme in ("sqlite", ""):
+        # stdlib path: sqlite:///file.db or a bare file path — no
+        # sqlalchemy needed (and none ships in this image)
+        import contextlib
+        import sqlite3
+
+        path = db.uri.split("///", 1)[-1] if "///" in db.uri else db.uri
+        # closing(): sqlite3's context manager only commits, it does NOT
+        # close — a daemon loading per-run would leak one fd per run
+        with contextlib.closing(sqlite3.connect(path)) as conn:
+            return _pandas().read_sql_query(query, conn)
+    try:
+        import sqlalchemy
+    except ImportError as e:
+        raise NotImplementedError(
+            f"sql dialect {scheme!r} needs sqlalchemy, which this "
+            "environment does not ship; use sqlite:/// or install "
+            "sqlalchemy at the node"
+        ) from e
+    engine = sqlalchemy.create_engine(db.uri)
+    with engine.connect() as conn:
+        return _pandas().read_sql(sqlalchemy.text(query), conn)
+
+
+def _load_sparql(db: DatabaseConfig) -> Any:
+    """SPARQL endpoint -> DataFrame (reference: SPARQLWrapper-based loader).
+
+    A SPARQL endpoint is plain HTTP: POST the query, ask for
+    ``application/sparql-results+json``, flatten the bindings. No
+    SPARQLWrapper dependency needed. The egress gate has already vetted the
+    endpoint host (http scheme) before this runs.
+    """
+    query = db.options.get("query")
+    if not query:
+        raise ValueError(f"sparql database {db.label!r} needs options.query")
+    import requests
+
+    try:
+        resp = requests.post(
+            db.uri,
+            data={"query": query},
+            headers={"Accept": "application/sparql-results+json"},
+            timeout=float(db.options.get("timeout", 60)),
+        )
+    except requests.RequestException as e:
+        raise ConnectionError(
+            f"sparql endpoint {db.uri!r} unreachable: {e}"
+        ) from None
+    if resp.status_code != 200:
+        raise ValueError(
+            f"sparql endpoint returned {resp.status_code}: {resp.text[:300]}"
+        )
+    payload = resp.json()
+    variables = payload.get("head", {}).get("vars", [])
+    rows = [
+        {var: binding.get(var, {}).get("value") for var in variables}
+        for binding in payload.get("results", {}).get("bindings", [])
+    ]
+    return _pandas().DataFrame(rows, columns=variables)
+
+
+def _load_omop(db: DatabaseConfig) -> Any:
+    """OMOP CDM database -> DataFrame (reference: OHDSI-tooling loader).
+
+    An OMOP source IS a SQL database holding the CDM schema; the loader
+    verifies the CDM marker table (``person``) exists, then runs the
+    configured query through the sql path — same URI forms and gates.
+    """
+    probe = DatabaseConfig(
+        label=db.label, type="sql", uri=db.uri,
+        options={"query": "SELECT 1 FROM person LIMIT 1"},
+    )
+    try:
+        _load_sql(probe)
+    except ValueError:
+        raise
+    except NotImplementedError:
+        raise
+    except Exception as e:
+        raise ValueError(
+            f"database {db.label!r} does not look like an OMOP CDM source "
+            f"(no readable 'person' table): {e}"
+        ) from None
+    return _load_sql(
+        DatabaseConfig(label=db.label, type="sql", uri=db.uri,
+                       options=db.options)
+    )
 
 
 def _pandas():
