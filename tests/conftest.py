@@ -11,6 +11,13 @@ initializes lazily) and `jax.config.update("jax_platforms")` re-selects the
 backend post-import.
 """
 import os
+import sys
+
+# make the suite runnable from any cwd without pip-installing the package:
+# the repo root (parent of tests/) is the import root for vantage6_tpu
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

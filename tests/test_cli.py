@@ -79,14 +79,14 @@ class TestServerImport:
         f.write_text(yaml.safe_dump(entities))
         r = runner.invoke(cli, ["server", "import", "imp", str(f)])
         assert r.exit_code == 0, r.output
-        summary = json.loads(r.output)
+        summary = json.loads(r.stdout)
         assert summary["organizations"] == 2
         assert summary["users"] == 1
         assert len(summary["nodes"]) == 2  # one per participant, with api keys
         assert all(n["api_key"] for n in summary["nodes"])
         # idempotent re-import creates nothing new
         r = runner.invoke(cli, ["server", "import", "imp", str(f)])
-        summary2 = json.loads(r.output)
+        summary2 = json.loads(r.stdout)
         assert summary2["organizations"] == 0 and summary2["nodes"] == []
 
 
@@ -145,8 +145,17 @@ class TestAlgorithmCreate:
 
         import os
 
+        # the child runs from tmp_path with no access to this checkout, so
+        # vantage6_tpu must be made importable explicitly — the package is
+        # not required to be pip-installed for the suite to pass
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
         child_env = {
             **os.environ,
+            "PYTHONPATH": os.pathsep.join(
+                p for p in (repo_root, os.environ.get("PYTHONPATH")) if p
+            ),
             # the child only needs CPU; letting it init the TPU backend is
             # slow and hangs outright when the accelerator is busy/wedged
             "JAX_PLATFORMS": "cpu",
@@ -195,7 +204,7 @@ class TestRun:
             ],
         )
         assert r.exit_code == 0, r.output
-        results = json.loads(r.output)
+        results = json.loads(r.stdout)
         assert len(results) == 2 and all("sum" in x for x in results)
 
 

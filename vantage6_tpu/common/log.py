@@ -42,6 +42,28 @@ def logger_name(special_char: str = "/") -> str:
     return (mod.__name__ if mod else "vantage6_tpu").replace(".", special_char)
 
 
+class _StderrHandler(logging.StreamHandler):
+    """StreamHandler resolving ``sys.stderr`` at EMIT time.
+
+    Module-level loggers are configured at import time, which may happen
+    while a test harness (pytest capture, click's CliRunner) has swapped
+    ``sys.stderr`` for a temporary buffer; binding that object would write
+    every later log record into a stale — possibly closed — stream. Looking
+    the stream up per record keeps logs on whatever stderr currently is.
+    """
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # base-class ctor compatibility; ignored
+        pass
+
+
 def setup_logging(
     name: str = "vantage6_tpu",
     level: int | str = logging.INFO,
@@ -54,7 +76,11 @@ def setup_logging(
     if getattr(logger, "_v6t_configured", False):
         return logger
     logger.setLevel(level)
-    console = logging.StreamHandler(sys.stderr)
+    # our handler is the single console sink — without this, a root handler
+    # installed by any other library (absl via jax, basicConfig in an app)
+    # would print every record a second time
+    logger.propagate = False
+    console = _StderrHandler()
     console.setFormatter(ColorFormatter(FORMAT, DATEFMT))
     logger.addHandler(console)
     if log_dir is not None:
