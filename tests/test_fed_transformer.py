@@ -80,3 +80,74 @@ def test_sequence_shards_see_full_context(engine):
     changed = np.asarray(logits_fn(params, mutated))
     # positions in the SECOND half (owned by the other sequence shard) react
     assert np.abs(base[:, :, 20:] - changed[:, :, 20:]).max() > 1e-6
+
+
+class TestFlashAndMixedPrecision:
+    """The Pallas flash kernel wired into the model (interpret mode on CPU)
+    and the bf16 compute path: same logits as the default f32 ring path."""
+
+    def _mini(self, attention="ring", dtype=jnp.float32):
+        return FT.TransformerConfig(
+            vocab=32, d_model=16, n_heads=2, n_layers=1, max_len=64,
+            dtype=dtype, attention=attention, flash_interpret=True,
+        )
+
+    def test_flash_forward_matches_ring(self):
+        cfg_ring = self._mini("ring")
+        cfg_flash = self._mini("flash")
+        eng = FT.make_engine(n_stations=2, seq_devices=1, cfg=cfg_ring)
+        tokens = FT.make_federated_tokens(2, batch=2, seq_len=16, vocab=32)
+        params, _ = eng.init(jax.random.key(3))
+
+        from jax.sharding import PartitionSpec as P
+
+        from vantage6_tpu.core.mesh import _NO_VMA_KW, STATION_AXIS, shard_map
+
+        def logits_fn(cfg, toks):
+            def body(params, tokens_block):
+                return FT.forward_local(params, tokens_block[0], cfg)[None]
+
+            return shard_map(
+                body,
+                mesh=eng.mesh,
+                in_specs=(P(), P(STATION_AXIS, None, FT.SEQ_AXIS)),
+                out_specs=P(STATION_AXIS, None, FT.SEQ_AXIS),
+                **_NO_VMA_KW,
+            )(params, eng.shard_tokens(jnp.asarray(toks)))
+
+        ring = np.asarray(logits_fn(cfg_ring, tokens))
+        flash = np.asarray(logits_fn(cfg_flash, tokens))
+        np.testing.assert_allclose(ring, flash, atol=2e-5, rtol=2e-5)
+
+    def test_flash_requires_full_sequence_per_device(self):
+        with pytest.raises(ValueError, match="seq_devices == 1"):
+            FT.make_engine(n_stations=2, seq_devices=2, cfg=self._mini("flash"))
+
+    def test_bf16_round_trains(self):
+        cfg = self._mini("ring", dtype=jnp.bfloat16)
+        eng = FT.make_engine(n_stations=2, seq_devices=1, cfg=cfg, lr=3e-3)
+        tokens = FT.make_federated_tokens(2, batch=4, seq_len=32, vocab=32)
+        sharded = eng.shard_tokens(tokens)
+        params, opt = eng.init(jax.random.key(4))
+        mask = jnp.ones(2)
+        first = None
+        for _ in range(15):
+            params, opt, loss = eng.round(params, opt, sharded, mask)
+            if first is None:
+                first = float(loss)
+        # params remain f32 master weights; loss decreases under bf16 compute
+        assert all(
+            leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(params)
+        )
+        assert np.isfinite(float(loss)) and float(loss) < first, (
+            first, float(loss),
+        )
+
+    def test_bf16_flash_round_trains(self):
+        cfg = self._mini("flash", dtype=jnp.bfloat16)
+        eng = FT.make_engine(n_stations=2, seq_devices=1, cfg=cfg, lr=3e-3)
+        tokens = FT.make_federated_tokens(2, batch=2, seq_len=16, vocab=32)
+        sharded = eng.shard_tokens(tokens)
+        params, opt = eng.init(jax.random.key(5))
+        params, opt, loss = eng.round(params, opt, sharded, jnp.ones(2))
+        assert np.isfinite(float(loss))

@@ -45,7 +45,10 @@ def _kernel(
     t_k = k_ref.shape[0]
     n_kb = t_k // block_k
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32)
+    # keep q/k in their native dtype: on bf16 inputs the MXU runs at bf16
+    # rate with float32 accumulation (preferred_element_type below); an
+    # upfront astype(f32) would silently demote to the f32 matmul rate
+    q = q_ref[:]
     q_pos = (
         qoff_ref[0]
         + qi * block_q
@@ -54,12 +57,12 @@ def _kernel(
 
     def body(kb, carry):
         m, l, acc = carry
-        kblk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        kblk = k_ref[pl.ds(kb * block_k, block_k), :]
+        vblk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
+        ) * scale  # [block_q, block_k], f32 accumulation
         k_idx = kb * block_k + lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1
         )
@@ -76,7 +79,7 @@ def _kernel(
         p = jnp.exp(s - m_new[:, None])
         l_new = l * corr + jnp.sum(p, axis=1)
         pv = jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_new = acc * corr[:, None] + pv
@@ -90,10 +93,6 @@ def _kernel(
     o_ref[:] = (acc / denom[:, None]).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
-)
 def flash_attention(
     q: jax.Array,  # [B, H, Tq, D]
     k: jax.Array,  # [B, H, Tk, D]
@@ -107,11 +106,52 @@ def flash_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention per (batch, head); Tq/Tk padded to block multiples
-    internally. Layout [B, H, T, D] (head-major for clean 2D tiles)."""
-    b, h, t_q, d = q.shape
-    t_k = k.shape[2]
+    internally. Layout [B, H, T, D] (head-major for clean 2D tiles).
+
+    Differentiable: the forward runs the Pallas kernel; the backward
+    recomputes attention (flash-style, nothing but q/k/v/o saved) and
+    applies the standard softmax-attention VJP in jnp — see
+    ``_attention_bwd``."""
+    d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d**0.5)
+    fn = _flash_vjp(causal, float(scale), block_q, block_k, interpret)
+    qoff = jnp.asarray(q_offset, jnp.int32)
+    koff = jnp.asarray(k_offset, jnp.int32)
+    return fn(q, k, v, qoff, koff)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_vjp(causal, scale, block_q, block_k, interpret):
+    """custom_vjp wrapper per static config (cached so jax sees ONE callable
+    per config — fresh wrappers would defeat jit tracing caches)."""
+
+    @jax.custom_vjp
+    def fa(q, k, v, qoff, koff):
+        return _flash_forward(
+            q, k, v, qoff, koff, causal, scale, block_q, block_k, interpret
+        )
+
+    def fwd(q, k, v, qoff, koff):
+        o = fa(q, k, v, qoff, koff)
+        return o, (q, k, v, o, qoff, koff)
+
+    def bwd(res, do):
+        q, k, v, o, qoff, koff = res
+        dq, dk, dv = _attention_bwd(
+            q, k, v, o, do, qoff, koff, causal, scale
+        )
+        return dq, dk, dv, None, None
+
+    fa.defvjp(fwd, bwd)
+    return fa
+
+
+def _flash_forward(
+    q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret
+) -> jax.Array:
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
     block_q = min(block_q, max(t_q, 8))
     block_k = min(block_k, max(t_k, 8))
     pad_q = (-t_q) % block_q
@@ -138,6 +178,10 @@ def flash_attention(
     kernel = functools.partial(
         _kernel, causal=causal, scale=scale, block_k=block_k
     )
+    # under shard_map with VMA checking, pallas_call outputs must declare
+    # which mesh axes they vary over — the output varies exactly as q does
+    # (frozenset() outside shard_map, i.e. no-op there)
+    vma = getattr(jax.typeof(q), "vma", None)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -152,11 +196,103 @@ def flash_attention(
                 (None, block_q, d), lambda bh, i, *_: (bh, i, 0)
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype, vma=vma),
         interpret=interpret,
     )(qoff, koff, kvalid, qh, kh, vh)
     out = out.reshape(b, h, tq_p, d)
     return out[:, :, :t_q]
+
+
+def _attention_bwd(
+    q, k, v, o, do, q_offset, k_offset, causal, scale, block_k: int = 128
+):
+    """Blockwise softmax-attention VJP with flash-style recompute.
+
+    Nothing from the forward is saved except (q, k, v, o); scores and
+    probabilities are recomputed BLOCKWISE over the key axis (lax.scan), so
+    peak transient memory is O(Tq * block_k) — linear in sequence length,
+    matching the forward kernel's scaling — never the dense [Tq, Tk]. Two
+    passes, both f32 regardless of the compute dtype:
+
+      pass 1: online-softmax statistics L = m + log(l)  (no V work)
+      pass 2, per key block j, with D = rowsum(do * o):
+        P_j = exp(S_j - L);  dV_j = P_j^T do;  dP_j = do V_j^T
+        dS_j = P_j * (dP_j - D);  dQ += dS_j K_j * scale;
+        dK_j = dS_j^T Q * scale.
+
+    Fully-masked query rows (forward outputs zeros there) have l = 0, so
+    every P_j entry underflows to 0 and their gradients vanish, matching
+    the forward's zero output.
+    """
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    block_k = min(block_k, t_k)
+    pad_k = (-t_k) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    n_blocks = (t_k + pad_k) // block_k
+    qf, of, dof = (x.astype(jnp.float32) for x in (q, o, do))
+    # [n_blocks, B, H, block_k, D] scan inputs
+    kb = jnp.moveaxis(
+        k.astype(jnp.float32).reshape(b, h, n_blocks, block_k, d), 2, 0
+    )
+    vb = jnp.moveaxis(
+        v.astype(jnp.float32).reshape(b, h, n_blocks, block_k, d), 2, 0
+    )
+    base = jnp.arange(n_blocks) * block_k
+    q_pos = jnp.reshape(q_offset, ()) + jnp.arange(t_q)
+    k_off = jnp.reshape(k_offset, ())
+
+    def block_scores(k_j, idx0):
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, k_j, preferred_element_type=jnp.float32
+        ) * scale
+        k_idx = idx0 + jnp.arange(block_k)
+        valid = (k_idx < t_k)[None, :]
+        if causal:
+            valid = valid & (q_pos[:, None] >= (k_off + k_idx)[None, :])
+        return jnp.where(valid[None, None], s, NEG_INF)
+
+    def stat_step(carry, blk):
+        m, l = carry
+        s = block_scores(*blk)
+        m_new = jnp.maximum(m, jnp.maximum(jnp.max(s, -1), -1e20))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(s - m_new[..., None]), -1
+        )
+        return (m_new, l), None
+
+    m0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
+    (m, l), _ = lax.scan(stat_step, (m0, jnp.zeros_like(m0)), (kb, base))
+    # L normalizer; l == 0 rows (fully masked) keep L = m so P stays 0
+    big_l = m + jnp.log(jnp.where(l > 0, l, 1.0))
+    d_term = jnp.sum(dof * of, axis=-1)  # [B, H, Tq]
+
+    def bwd_step(dq_acc, blk):
+        k_j, v_j, idx0 = blk
+        p = jnp.exp(block_scores(k_j, idx0) - big_l[..., None])
+        dv_j = jnp.einsum(
+            "bhqk,bhqd->bhkd", p, dof, preferred_element_type=jnp.float32
+        )
+        dp = jnp.einsum(
+            "bhqd,bhkd->bhqk", dof, v_j, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - d_term[..., None])
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, k_j, preferred_element_type=jnp.float32
+        ) * scale
+        dk_j = jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32
+        ) * scale
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dkb, dvb) = lax.scan(
+        bwd_step, jnp.zeros((b, h, t_q, d), jnp.float32), (kb, vb, base)
+    )
+    dk = jnp.moveaxis(dkb, 0, 2).reshape(b, h, t_k + pad_k, d)[:, :, :t_k]
+    dv = jnp.moveaxis(dvb, 0, 2).reshape(b, h, t_k + pad_k, d)[:, :, :t_k]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def reference(

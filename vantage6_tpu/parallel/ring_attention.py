@@ -42,8 +42,16 @@ def _block_attention(
     mask: jax.Array | None,  # [Tq, Tk] additive (0 / NEG_INF)
     scale: float,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One block's contribution folded into the online-softmax accumulators."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    """One block's contribution folded into the online-softmax accumulators.
+
+    Accumulators (m, l, o) are float32 regardless of the q/k/v dtype: on
+    bf16 inputs the two einsums run at the MXU's bf16 rate but accumulate in
+    f32 (``preferred_element_type``), and the softmax statistics stay f32 —
+    the standard mixed-precision attention recipe.
+    """
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if mask is not None:
         scores = scores + mask[None, None, :, :]
     block_max = jnp.max(scores, axis=-1)  # [B, H, Tq]
@@ -53,9 +61,12 @@ def _block_attention(
     m_new = jnp.maximum(jnp.maximum(m, block_max), -1e20)
     # correction for previously accumulated terms
     corr = jnp.exp(m - m_new)
-    p = jnp.exp(scores - m_new[..., None])  # [B, H, Tq, Tk]
+    p = jnp.exp(scores - m_new[..., None])  # [B, H, Tq, Tk] f32
     l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -99,11 +110,12 @@ def ring_attention(
 
     # accumulators derive from q so their varying-axis type matches the
     # scan outputs (a plain constant would be 'unvarying' under shard_map's
-    # VMA tracking and fail the scan carry type check)
-    qv = q[..., 0].transpose(0, 2, 1)  # [B, H, Tq]
+    # VMA tracking and fail the scan carry type check); f32 regardless of
+    # input dtype (see _block_attention)
+    qv = q[..., 0].transpose(0, 2, 1).astype(jnp.float32)  # [B, H, Tq]
     m0 = qv * 0 + NEG_INF
     l0 = qv * 0
-    o0 = q * 0
+    o0 = (q * 0).astype(jnp.float32)
     (k_f, v_f, m, l, o), _ = lax.scan(
         step, (k, v, m0, l0, o0), jnp.arange(axis_size)
     )
@@ -111,7 +123,7 @@ def ring_attention(
     # normalize; fully-masked rows (can't happen for causal contiguous
     # layouts, but guard anyway) yield zeros not NaN
     denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
-    return o / denom
+    return (o / denom).astype(q.dtype)
 
 
 def reference_attention(

@@ -25,8 +25,9 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from vantage6_tpu.core.mesh import STATION_AXIS, shard_map
+from vantage6_tpu.core.mesh import _NO_VMA_KW, STATION_AXIS, shard_map
 from vantage6_tpu.fed import collectives
+from vantage6_tpu.ops.flash_attention import flash_attention
 from vantage6_tpu.parallel.ring_attention import ring_attention
 
 SEQ_AXIS = "device"  # sequence parallelism rides the within-station axis
@@ -39,6 +40,16 @@ class TransformerConfig:
     n_heads: int = 4
     n_layers: int = 2
     max_len: int = 2048
+    # Mixed precision: params/optimizer stay float32 (master weights); all
+    # matmuls run in `dtype`. bfloat16 is the MXU-rate dtype on TPU; softmax
+    # statistics, layernorm and the loss stay f32 either way.
+    dtype: Any = jnp.float32
+    # "ring": exact ring attention over the sequence axis (any seq_devices).
+    # "flash": the Pallas flash kernel (ops.flash_attention) — requires the
+    # full sequence on each device (seq_devices == 1, enforced by
+    # make_engine); `flash_interpret` runs it in interpret mode on CPU.
+    attention: str = "ring"
+    flash_interpret: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -68,9 +79,11 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict[str, Any]:
 
 
 def _ln(x: jax.Array) -> jax.Array:
-    mu = jnp.mean(x, -1, keepdims=True)
-    var = jnp.var(x, -1, keepdims=True)
-    return (x - mu) * lax.rsqrt(var + 1e-6)
+    # normalization statistics in f32 even under bf16 compute
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + 1e-6)).astype(x.dtype)
 
 
 def forward_local(
@@ -83,20 +96,41 @@ def forward_local(
     sequence via the ring."""
     b, t_local = tokens_local.shape
     offset = lax.axis_index(axis_name) * t_local  # global positions
-    x = params["embed"][tokens_local]
-    x = x + lax.dynamic_slice_in_dim(params["pos"], offset, t_local, 0)[None]
+
+    def cast(w: jax.Array) -> jax.Array:
+        return w.astype(cfg.dtype)
+
+    x = cast(params["embed"])[tokens_local]
+    x = x + cast(
+        lax.dynamic_slice_in_dim(params["pos"], offset, t_local, 0)
+    )[None]
     for layer in params["layers"]:
+        layer = jax.tree.map(cast, layer)
         h = _ln(x)
         qkv = h @ layer["qkv"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t_local, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, t_local, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, t_local, cfg.n_heads, cfg.head_dim)
-        attn = ring_attention(q, k, v, axis_name, causal=True)
+        if cfg.attention == "flash":
+            # Pallas kernel wants head-major [B, H, T, D]; offsets keep the
+            # causal mask correct for any sequence shard (here the full
+            # sequence — make_engine enforces seq_devices == 1 for flash)
+            attn = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                q_offset=offset,
+                k_offset=offset,
+                causal=True,
+                interpret=cfg.flash_interpret,
+            ).transpose(0, 2, 1, 3)
+        else:
+            attn = ring_attention(q, k, v, axis_name, causal=True)
         x = x + attn.reshape(b, t_local, cfg.d_model) @ layer["proj"]
         h = _ln(x)
         x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
-    return _ln(x) @ params["embed"].T
+    return _ln(x) @ cast(params["embed"]).T
 
 
 def loss_local(
@@ -171,11 +205,18 @@ class FedTransformer:
                 jax.tree.map(lambda g: g[None], grads),
             )
 
+        # Variance checking OFF, same stance (and reason) as fed_map: the
+        # station body is a purely local program whose only cross-device
+        # reductions are the EXPLICIT psums over SEQ_AXIS above; it also
+        # works around the pallas-interpret + VMA interaction that rejects
+        # the flash kernel inside a checked shard_map (jax 0.9 asks for
+        # exactly this workaround).
         losses, grads = shard_map(
             station_body,
             mesh=self.mesh,
             in_specs=(P(), P(STATION_AXIS, None, SEQ_AXIS)),
             out_specs=(P(STATION_AXIS), P(STATION_AXIS)),
+            **_NO_VMA_KW,
         )(params, tokens)
         # explicit cross-station aggregation: the ONLY place station data mixes
         g_mean = collectives.fed_mean(grads, mask=mask)
@@ -193,6 +234,12 @@ def make_engine(
     devices: Any = None,
 ) -> FedTransformer:
     cfg = cfg or TransformerConfig()
+    if cfg.attention == "flash" and seq_devices != 1:
+        raise ValueError(
+            "attention='flash' needs the full sequence per device "
+            f"(seq_devices == 1, got {seq_devices}); use 'ring' for "
+            "sequence-parallel runs"
+        )
     devs = list(devices if devices is not None else jax.devices())
     need = n_stations * seq_devices
     if len(devs) < need:
