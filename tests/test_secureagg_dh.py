@@ -197,3 +197,161 @@ class TestWorkloadEndToEnd:
                     np.asarray(run.result["masked"]),
                     native.quantize(plain, scale),
                 )
+
+
+class TestSignedAdverts:
+    """Active-MitM resistance: X25519 adverts bound to org RSA identity keys
+    (VERDICT r2 missing #3). A relay substituting its own DH keys now fails
+    closed at every verifying station."""
+
+    @pytest.fixture(scope="class")
+    def identities(self):
+        from vantage6_tpu.common.encryption import RSACryptor
+
+        return [RSACryptor(RSACryptor.create_new_rsa_key())
+                for _ in range(2)]
+
+    def test_substituted_pubkey_fails_closed(self, identities):
+        tag = "agg-s"
+        secrets_, pubs = _setup(2, tag)
+        idents = {i: c.public_key_str for i, c in enumerate(identities)}
+        sigs = {
+            i: dh.sign_advert(identities[i], i, pubs[i], tag)
+            for i in range(2)
+        }
+        v = np.ones(3, np.float32)
+        # honest relay: verification passes, upload proceeds
+        up = dh.mask_update_dh(secrets_[0], 0, pubs, v, tag=tag,
+                               identities=idents, signatures=sigs)
+        assert up.shape == (3,)
+
+        # malicious relay swaps station 1's DH key for its own (classic
+        # MitM) but cannot forge the org signature
+        from vantage6_tpu.common.secureagg_dh import derive_keypair
+
+        _, evil_pub = derive_keypair(b"\xEE" * 32, tag)
+        tampered = dict(pubs)
+        tampered[1] = evil_pub
+        with pytest.raises(ValueError, match="INVALID"):
+            dh.mask_update_dh(secrets_[0], 0, tampered, v, tag=tag,
+                              identities=idents, signatures=sigs)
+
+    def test_missing_signature_fails_closed(self, identities):
+        tag = "agg-s2"
+        secrets_, pubs = _setup(2, tag)
+        idents = {i: c.public_key_str for i, c in enumerate(identities)}
+        with pytest.raises(ValueError, match="unauthenticated"):
+            dh.mask_update_dh(
+                secrets_[0], 0, pubs, np.ones(2, np.float32), tag=tag,
+                identities=idents, signatures={},
+            )
+
+    def test_signature_not_replayable_across_tags_or_stations(self, identities):
+        tag = "agg-s3"
+        secrets_, pubs = _setup(2, tag)
+        idents = {i: c.public_key_str for i, c in enumerate(identities)}
+        sigs = {
+            i: dh.sign_advert(identities[i], i, pubs[i], tag)
+            for i in range(2)
+        }
+        # same adverts + signatures replayed under a different tag: the
+        # canonical message binds the tag, so verification fails
+        with pytest.raises(ValueError, match="INVALID"):
+            dh.verify_adverts(pubs, idents, sigs, "other-tag")
+        # and a signature cannot vouch for a different station index
+        swapped = {0: sigs[1], 1: sigs[0]}
+        with pytest.raises(ValueError, match="INVALID"):
+            dh.verify_adverts(pubs, idents, swapped, tag)
+
+
+class TestWorkloadSignedAdverts:
+    """The DH workload actually uses the signing path end-to-end: adverts
+    are signed under the Federation's provisioned identities, stations
+    verify rosters, and a substituted pubkey aborts the upload."""
+
+    def test_federation_adverts_are_signed_and_verified(self):
+        import pandas as pd
+
+        from vantage6_tpu.runtime.federation import federation_from_datasets
+        from vantage6_tpu.workloads import secure_average
+
+        rng = np.random.default_rng(21)
+        frames = [
+            pd.DataFrame({"age": rng.normal(50, 4, 40)}) for _ in range(2)
+        ]
+        fed = federation_from_datasets(
+            frames, {"v6-secure-average": secure_average}
+        )
+        task = fed.create_task(
+            "v6-secure-average",
+            {
+                "method": "central_secure_average_dh",
+                "kwargs": {"column": "age", "max_abs": 2.0**16},
+            },
+            organizations=[0],
+        )
+        out = fed.wait_for_results(task.id)[0]
+        pooled = pd.concat(frames)["age"]
+        assert abs(out["average"] - pooled.mean()) < 1e-3
+        # every advert that crossed the relay carried a signature
+        adverts = [
+            run.result
+            for t in fed.tasks.values()
+            if t.method == "partial_advertise_mask_key"
+            for run in t.runs
+        ]
+        assert adverts and all(a.get("signature") for a in adverts)
+
+    def test_substituted_pubkey_aborts_upload(self):
+        import pandas as pd
+
+        from vantage6_tpu.algorithm.context import (
+            AlgorithmEnvironment,
+            algorithm_environment,
+        )
+        from vantage6_tpu.common.encryption import RSACryptor
+        from vantage6_tpu.workloads.secure_average import (
+            partial_secure_average_dh,
+        )
+
+        idents = [RSACryptor(RSACryptor.create_new_rsa_key())
+                  for _ in range(2)]
+        secrets_ = [bytes([7 + i]) * 32 for i in range(2)]
+        tag = "agg-e2e"
+        pubs = [dh.derive_keypair(s, tag)[1] for s in secrets_]
+        sigs = [
+            [i, dh.sign_advert(idents[i], i, pubs[i], tag)]
+            for i in range(2)
+        ]
+        registry = {i: c.public_key_str for i, c in enumerate(idents)}
+        # the relay swaps party 1's key for its own
+        _, evil = dh.derive_keypair(b"\xEE" * 32, tag)
+        env = AlgorithmEnvironment(
+            dataframes=[pd.DataFrame({"age": [1.0, 2.0]})],
+            station_secret=secrets_[0],
+            org_identities=registry,
+        )
+        with algorithm_environment(env):
+            with pytest.raises(ValueError, match="INVALID"):
+                partial_secure_average_dh(
+                    column="age",
+                    party_index=0,
+                    pubkeys=[[0, pubs[0]], [1, evil]],
+                    scale=2.0**10,
+                    max_abs=2.0**16,
+                    agg_tag=tag,
+                    org_ids=[0, 1],
+                    signatures=sigs,
+                )
+            # and a shrunk roster (relay drops party 1 entirely) also fails
+            with pytest.raises(ValueError, match="roster"):
+                partial_secure_average_dh(
+                    column="age",
+                    party_index=0,
+                    pubkeys=[[0, pubs[0]]],
+                    scale=2.0**10,
+                    max_abs=2.0**16,
+                    agg_tag=tag,
+                    org_ids=[0, 1],
+                    signatures=sigs,
+                )

@@ -38,6 +38,18 @@ class AlgorithmEnvironment:
     # per-pair DH mask agreement (common.secureagg_dh) — never leaves the
     # station, never crosses the task payload boundary
     station_secret: bytes | None = None
+    # this station's organization RSA identity (encryption.RSACryptor) —
+    # signs secure-aggregation adverts (secureagg_dh.sign_advert). May be
+    # the cryptor itself OR a zero-arg factory returning it (accessors in
+    # secureagg_dh resolve either) so second-scale RSA keygen only happens
+    # for algorithms that sign.
+    identity: Any = None
+    # trust registry: station index -> base64 PEM RSA public identity key,
+    # distributed at onboarding (NOT through the task relay). When present,
+    # secure-aggregation workloads verify peer adverts against it and fail
+    # closed on mismatch (active-MitM resistance). Same value-or-factory
+    # convention as `identity`.
+    org_identities: Any = None
 
 
 _current: contextvars.ContextVar[AlgorithmEnvironment | None] = (

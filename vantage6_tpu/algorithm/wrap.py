@@ -63,10 +63,33 @@ def wrap_algorithm(module: ModuleType | str | None = None) -> None:
         )
 
     secret_hex = os.environ.get("V6T_STATION_SECRET", "")
+    # org identity ABI (advert signing): V6T_IDENTITY_KEY = path to this
+    # org's RSA PEM (node config); V6T_ORG_IDENTITIES = JSON
+    # {station index: base64 PEM public key} trust roster
+    identity = None
+    identity_path = os.environ.get("V6T_IDENTITY_KEY", "")
+    if identity_path:
+        # zero-arg factory, per the AlgorithmEnvironment convention: loading
+        # (and on first start GENERATING, seconds of 4096-bit keygen) the
+        # key must only happen for algorithms that actually sign
+        def identity(path=identity_path):
+            from vantage6_tpu.common.encryption import RSACryptor
+
+            return RSACryptor(path)
+    org_identities = None
+    idents_json = os.environ.get("V6T_ORG_IDENTITIES", "")
+    if idents_json:
+        import json as _json
+
+        org_identities = {
+            int(k): v for k, v in _json.loads(idents_json).items()
+        }
     env = AlgorithmEnvironment(
         dataframes=_load_env_databases(),
         client=_maybe_rest_client(),
         station_secret=bytes.fromhex(secret_hex) if secret_hex else None,
+        identity=identity,
+        org_identities=org_identities,
         metadata=RunMetadata(
             task_id=_int_env("TASK_ID"),
             run_id=_int_env("RUN_ID"),

@@ -111,6 +111,20 @@ class RSACryptor(CryptorBase):
         """Does the (server-registered) public key match our private key?"""
         return pubkey_base64 == self.public_key_str
 
+    # -------------------------------------------------------------- identity
+    def sign_bytes(self, data: bytes) -> bytes:
+        """RSA-PSS(SHA-256) signature binding ``data`` to this organization's
+        identity key — used e.g. to authenticate secure-aggregation key
+        adverts against an ACTIVE (key-substituting) relay."""
+        return self.private_key.sign(
+            data,
+            padding.PSS(
+                mgf=padding.MGF1(hashes.SHA256()),
+                salt_length=padding.PSS.MAX_LENGTH,
+            ),
+            hashes.SHA256(),
+        )
+
     # -------------------------------------------------------------- transport
     def encrypt_bytes_to_str(self, data: bytes, pubkey_base64: str) -> str:
         recipient = serialization.load_pem_public_key(
@@ -130,6 +144,31 @@ class RSACryptor(CryptorBase):
         return SEPARATOR.join(
             self.bytes_to_str(part) for part in (sealed, nonce, ciphertext)
         )
+
+    @staticmethod
+    def verify_signature(
+        pubkey_base64: str, data: bytes, signature: bytes
+    ) -> bool:
+        """Check an RSA-PSS(SHA-256) signature against an organization's
+        registered public key (base64 PEM, as stored by the server)."""
+        from cryptography.exceptions import InvalidSignature
+
+        pub = serialization.load_pem_public_key(
+            CryptorBase.str_to_bytes(pubkey_base64)
+        )
+        try:
+            pub.verify(
+                signature,
+                data,
+                padding.PSS(
+                    mgf=padding.MGF1(hashes.SHA256()),
+                    salt_length=padding.PSS.MAX_LENGTH,
+                ),
+                hashes.SHA256(),
+            )
+            return True
+        except InvalidSignature:
+            return False
 
     def decrypt_str_to_bytes(self, data: str) -> bytes:
         try:

@@ -62,6 +62,8 @@ class TaskRunner:
         mode: str = "sandbox",
         work_dir: str | Path | None = None,
         station_secret: str | bytes | None = None,
+        identity_key_path: str | None = None,
+        org_identities: dict[int, str] | None = None,
     ):
         """``algorithms`` maps image name -> importable module path.
 
@@ -71,6 +73,9 @@ class TaskRunner:
         ``station_secret`` (hex str or bytes) is this station's local secret
         for DH mask agreement (common.secureagg_dh); it is handed only to
         the algorithm's own run environment, never uploaded.
+        ``identity_key_path`` / ``org_identities`` (node config) provision
+        the org RSA identity key and the trusted identity-pubkey roster for
+        secure-aggregation advert signing/verification (wrap.py ABI).
         """
         self.algorithms = dict(algorithms or {})
         self.databases = {d["label"]: d for d in (databases or [])}
@@ -78,6 +83,8 @@ class TaskRunner:
         if isinstance(station_secret, str):
             station_secret = bytes.fromhex(station_secret)
         self.station_secret = station_secret
+        self.identity_key_path = identity_key_path
+        self.org_identities = dict(org_identities or {}) or None
         # network gates (reference items 14/15): egress whitelist consulted
         # on every remote data-loading URI; ssh tunnel endpoints resolved for
         # databases that address them by name
@@ -188,11 +195,21 @@ class TaskRunner:
                 collaboration=spec.metadata.get("collaboration", ""),
             ),
             station_secret=self.station_secret,
+            identity=(
+                self._load_identity if self.identity_key_path else None
+            ),
+            org_identities=self.org_identities,
         )
         args = spec.input_payload.get("args", []) or []
         kwargs = spec.input_payload.get("kwargs", {}) or {}
         with algorithm_environment(env):
             return fn(*args, **kwargs)
+
+    def _load_identity(self):
+        """Lazy org-identity cryptor (zero-arg factory for the run env)."""
+        from vantage6_tpu.common.encryption import RSACryptor
+
+        return RSACryptor(self.identity_key_path)
 
     # ----------------------------------------------------------- sandbox
     def _run_sandbox(self, module: str, spec: RunSpec) -> Any:
@@ -205,8 +222,17 @@ class TaskRunner:
         input_file.write_bytes(serialize(spec.input_payload))
         token_file.write_text(spec.token)
 
+        # the child must be able to import vantage6_tpu regardless of the
+        # node's cwd or whether the package is pip-installed: pin the
+        # directory that contains this very package onto its PYTHONPATH
+        import vantage6_tpu
+
+        pkg_root = str(Path(vantage6_tpu.__file__).resolve().parent.parent)
         env = {
             **os.environ,
+            "PYTHONPATH": os.pathsep.join(
+                p for p in (pkg_root, os.environ.get("PYTHONPATH")) if p
+            ),
             "INPUT_FILE": str(input_file),
             "OUTPUT_FILE": str(output_file),
             "TOKEN_FILE": str(token_file),
@@ -224,6 +250,12 @@ class TaskRunner:
             env["V6T_SERVER_URL"] = spec.server_url
         if self.station_secret:
             env["V6T_STATION_SECRET"] = self.station_secret.hex()
+        if self.identity_key_path:
+            env["V6T_IDENTITY_KEY"] = str(self.identity_key_path)
+        if self.org_identities:
+            env["V6T_ORG_IDENTITIES"] = json.dumps(
+                {str(k): v for k, v in self.org_identities.items()}
+            )
         # network gates cross the ABI as JSON so the sandboxed loader
         # enforces the same egress policy the inline path does
         if self.egress.enabled:

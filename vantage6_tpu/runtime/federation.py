@@ -76,6 +76,9 @@ class Federation:
         self._station_secrets = [
             _secrets.token_bytes(32) for _ in range(config.n_stations)
         ]
+        # org RSA identity keys (advert signing, secureagg_dh): generated
+        # LAZILY — RSA keygen costs seconds and most workloads never sign
+        self._identity_cryptors: list[Any] = [None] * config.n_stations
         # station data: per-station {label: dataset}; device-mode stacked
         # arrays cached per label.
         self._data: list[dict[str, Any]] = [{} for _ in self.stations]
@@ -298,6 +301,27 @@ class Federation:
             for run in runnable:
                 self._run_host(task, fn, run)
 
+    # -------------------------------------------------------------- identity
+    def _station_identity(self, station: int):
+        """This station's org RSA identity cryptor (lazy keygen, cached) —
+        each real node would hold its own key file; the simulator generates
+        one per station the first time an algorithm signs."""
+        if self._identity_cryptors[station] is None:
+            from vantage6_tpu.common.encryption import RSACryptor
+
+            self._identity_cryptors[station] = RSACryptor(
+                RSACryptor.create_new_rsa_key()
+            )
+        return self._identity_cryptors[station]
+
+    def _org_identity_registry(self) -> dict[int, str]:
+        """station index -> base64 PEM public identity key, for ALL
+        stations — the out-of-band trust root advert verification needs."""
+        return {
+            i: self._station_identity(i).public_key_str
+            for i in range(self.n_stations)
+        }
+
     # ------------------------------------------------------------- host mode
     def _run_host(self, task: Task, fn: Callable, run: Run) -> None:
         from vantage6_tpu.algorithm.client import AlgorithmClient
@@ -318,6 +342,10 @@ class Federation:
                 collaboration=self.config.name,
             ),
             station_secret=self._station_secrets[run.station_index],
+            # zero-arg factories: RSA keygen costs seconds, so identities
+            # materialize only if the algorithm actually signs/verifies
+            identity=lambda i=run.station_index: self._station_identity(i),
+            org_identities=self._org_identity_registry,
         )
         args = task.input_.get("args", []) or []
         kwargs = task.input_.get("kwargs", {}) or {}

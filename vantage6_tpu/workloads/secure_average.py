@@ -144,11 +144,20 @@ def partial_advertise_mask_key(party_index: int, agg_tag: str) -> dict[str, Any]
     """Round 1: publish this station's per-aggregation X25519 public key.
 
     The keypair derives deterministically from the station-LOCAL secret and
-    the tag, so round 2 re-derives the same private key with no state."""
+    the tag, so round 2 re-derives the same private key with no state.
+    When the runtime provisions an org RSA identity, the advert is signed
+    (RSA-PSS over the canonical (party, pubkey, tag) message) so verifying
+    peers detect a key-substituting relay."""
     from vantage6_tpu.common import secureagg_dh as dh
 
     _, pub_hex = dh.derive_keypair(dh.get_station_secret(), agg_tag)
-    return {"party_index": party_index, "pubkey": pub_hex}
+    out = {"party_index": party_index, "pubkey": pub_hex}
+    identity = dh.get_identity()
+    if identity is not None:
+        out["signature"] = dh.sign_advert(
+            identity, party_index, pub_hex, agg_tag
+        )
+    return out
 
 
 @data(1)
@@ -160,12 +169,51 @@ def partial_secure_average_dh(
     scale: float,
     max_abs: float,
     agg_tag: str,
+    org_ids: list[int] | None = None,
+    signatures: list[list[Any]] | None = None,
 ) -> dict[str, Any]:
     """Round 2: upload = DH-masked [sum, count]; same clipping contract as
     the single-seed variant. ``pubkeys`` is [[party_index, pub_hex], ...]
-    for ALL parties (wire-safe pair list; JSON would stringify int keys)."""
+    for ALL parties (wire-safe pair list; JSON would stringify int keys).
+
+    Advert authentication (fail closed): when this station's runtime
+    provisions an identity-pubkey roster (org_identities), the relayed
+    adverts MUST verify against it — ``org_ids`` maps party_index to the
+    organization/station id the roster is keyed by, and ``signatures`` is
+    [[party_index, sig_hex], ...]. A relay substituting pubkeys (or
+    shrinking the roster) aborts the round here instead of silently
+    unmasking this station's upload.
+    """
     from vantage6_tpu.common import secureagg_dh as dh
 
+    pub_map = {int(i): p for i, p in pubkeys}
+    identities = None
+    sig_map = {int(i): s for i, s in (signatures or [])}
+    registry = dh.get_org_identities()
+    if registry is not None:
+        if org_ids is None:
+            raise ValueError(
+                "identity roster provisioned but task carries no org_ids — "
+                "cannot verify adverts; refusing to upload"
+            )
+        # org_ids arrives THROUGH the relay being defended against, so it
+        # cannot be trusted to pick the participant subset: a relay could
+        # shrink it to {victim} (every remaining advert validly signed) and
+        # the victim would upload with zero pairwise masks. The roster must
+        # be exactly the locally-provisioned registry — the out-of-band
+        # trust root. Subset aggregations under verification need a roster
+        # signed by the initiating user (not implemented; run the full
+        # collaboration or provision a per-study registry).
+        if {int(o) for o in org_ids} != set(registry):
+            raise ValueError(
+                "aggregation roster does not match the provisioned identity "
+                f"registry (task: {sorted(int(o) for o in org_ids)}, "
+                f"registry: {sorted(registry)}) — refusing a relay-chosen "
+                "participant subset"
+            )
+        identities = {
+            idx: registry[int(org)] for idx, org in enumerate(org_ids)
+        }
     col = df[column]
     vec = np.clip(
         np.asarray([col.sum(), float(col.count())], np.float32),
@@ -175,10 +223,12 @@ def partial_secure_average_dh(
     masked = dh.mask_update_dh(
         dh.get_station_secret(),
         party_index,
-        {int(i): p for i, p in pubkeys},
+        pub_map,
         vec,
         scale,
         tag=agg_tag,
+        identities=identities,
+        signatures=sig_map,
     )
     return {"masked": masked, "party_index": party_index}
 
@@ -193,12 +243,14 @@ def central_secure_average_dh(
     """Secure average with NO shared seed: this central function (and an
     honest-but-curious server relaying everything) sees only public keys
     and masked uploads and cannot reconstruct an individual station's
-    [sum, count]. An ACTIVE malicious server could substitute relayed
-    pubkeys (see common.secureagg_dh scope notes) — signing adverts with
-    org identity keys is the planned hardening.
+    [sum, count]. When the stations' runtimes provision org identity keys,
+    adverts are signed and every station verifies the full roster before
+    uploading — a key-substituting (active MitM) relay makes the round fail
+    closed (tests/test_secureagg_dh.py::TestSignedAdverts; THREAT_MODEL.md).
 
-    No dropout recovery: every advertiser must upload (see secureagg_dh) —
-    a missing upload leaves masks uncancelled and the round is retried.
+    No dropout recovery: every advertiser must upload (see
+    common.secureagg_bonawitz for the recovering variant) — a missing
+    upload leaves masks uncancelled and the round is retried.
     """
     import secrets
 
@@ -228,11 +280,16 @@ def central_secure_average_dh(
             )
         )
     pubkeys: list[list[Any]] = []
+    signatures: list[list[Any]] = []
     for sub in adverts:
         r = client.wait_for_results(task_id=sub["id"])[0]
         pubkeys.append([int(r["party_index"]), r["pubkey"]])
+        if r.get("signature"):
+            signatures.append([int(r["party_index"]), r["signature"]])
 
-    # round 2: masked uploads under the advertised keys
+    # round 2: masked uploads under the advertised keys (signatures and the
+    # party->org mapping relayed so each station can verify the roster
+    # against its LOCAL identity registry)
     subtasks = []
     for idx, org in enumerate(orgs):
         subtasks.append(
@@ -246,6 +303,8 @@ def central_secure_average_dh(
                         "scale": scale,
                         "max_abs": max_abs,
                         "agg_tag": agg_tag,
+                        "org_ids": [int(o) for o in orgs],
+                        "signatures": signatures,
                     },
                 },
                 organizations=[org],
