@@ -1,4 +1,5 @@
-"""Benchmark: federated rounds/sec, 32-station FedAvg CNN (BASELINE.md).
+"""Benchmark: federated rounds/sec, 32-station FedAvg CNN (BASELINE.md),
+plus an MXU-utilization metric on the federated transformer.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
@@ -12,8 +13,21 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
   HTTPS, NO polling intervals. The reference's real per-round cost is
   dominated by exactly those omitted parts, so the reported speedup is a
   conservative lower bound.
+- Transformer: one federated training step of the long-context workload at
+  an MXU-friendly size (bf16, d_model 1024, seq 1024) with analytic FLOPs —
+  the metric where "TPU-native" means hardware utilization, not just
+  "faster than a sequential CPU loop" (VERDICT r2 weak #2).
 
-Identical math both paths (same model/hyperparams/station count).
+Accuracy parity (BASELINE.md criterion): both FedAvg paths train the same
+number of rounds and evaluate their final model on the SAME held-out set;
+both accuracies and their gap are reported.
+
+Timing protocol (VERDICT r2 weak #1 — the r2 artifact was invalid): every
+measurement compiles once, runs once warm, DISCARDS the first post-warm
+execution (on the tunneled runtime its completion signal returns ~2000x
+early), then times >=3 back-to-back executions and reports the median.
+Derived MFU is sanity-checked: mfu > 1 is physically impossible and flips
+"timing_valid" to false instead of publishing an impossible number.
 
 Process architecture (VERDICT r1 weak #1): the parent NEVER initializes a
 JAX backend. Every measurement runs in a subprocess with a hard timeout,
@@ -34,14 +48,29 @@ N_PER_STATION = 256
 LOCAL_STEPS = 10
 BATCH = 32
 LR = 0.05
-SPMD_ROUNDS = 20        # on the real TPU
+# Rounds per timed execution AND the accuracy-parity leg. 5 keeps the CPU
+# baseline inside its budget: its per-round cost is ~140 s compute + ~230 s
+# compile on this host (phase_seconds in the worker output), so 5 rounds +
+# 5 hop-instrumented timing rounds + eval ~= 1000 s < WORKER_TIMEOUT_S.
+# On TPU a timed run is then ~180 ms — ample resolution.
+SPMD_ROUNDS = 5
 SPMD_ROUNDS_CPU = 5     # fallback: CPU execution is ~100x slower per round
-BASELINE_ROUNDS = 5     # target (VERDICT r1: >= 5); time-boxed below
-BASELINE_MAX_S = 240.0  # stop the baseline loop after this much wall time
+TIMED_RUNS = 3          # median of this many post-discard executions
+BASELINE_TIMING_ROUNDS = 5   # >= 5 measured rounds (VERDICT r1/r2)
+BASELINE_TIMING_STATIONS = 4  # hop-instrumented stations per timing round
+BASELINE_MAX_S = 900.0  # stop the baseline accuracy loop after this much
 PROBE_TIMEOUT_S = 110       # wedged tunnel hangs jax.devices() for 40+ min
 WORKER_TIMEOUT_S = 1500
-# TPU v5e: 197 TFLOP/s bf16 per chip (the CNN computes in bf16 on the MXU).
+ACC_TOLERANCE = 0.05    # |acc_spmd - acc_baseline| for "accuracy_parity"
+# TPU v5e: 197 TFLOP/s bf16 per chip (both workloads compute in bf16-friendly
+# shapes; the CNN runs f32 on data this small — the MFU figure is reported
+# against the bf16 peak as the honest *upper* reference either way).
 V5E_BF16_PEAK_FLOPS = 1.97e14
+
+# MXU-friendly transformer bench shape (single chip).
+TF_D, TF_LAYERS, TF_HEADS, TF_SEQ, TF_BATCH, TF_VOCAB = 1024, 8, 8, 1024, 8, 4096
+# CPU fallback shape: just proves the path runs; no MFU claim.
+TF_CPU = dict(d=64, layers=2, heads=2, seq=128, batch=2, vocab=256)
 
 
 def cnn_train_flops_per_round() -> float:
@@ -64,9 +93,34 @@ def cnn_train_flops_per_round() -> float:
     return 3.0 * fwd_per_example * BATCH * LOCAL_STEPS * N_STATIONS
 
 
+def transformer_train_flops(
+    d: int, n_layers: int, seq: int, batch: int, vocab: int
+) -> float:
+    """Analytic FLOPs of one training step (fwd*3), model FLOPs only.
+
+    Per token forward:
+      qkv proj     2 * d * 3d           = 6 d^2
+      out proj     2 * d * d            = 2 d^2
+      mlp          2 * d * 4d * 2       = 16 d^2
+      attention    causal QK^T + PV: avg (T+1)/2 keys/query, 2*2d per key
+                                        = 2 d (T+1)
+      (per layer: 24 d^2 + 2 d (T+1))
+      lm head      2 * d * vocab
+    Causal attention counts the REQUIRED (T+1)/2 average context, not the
+    full T the kernel may compute — conservative for MFU.
+    """
+    per_layer = 24.0 * d * d + 2.0 * d * (seq + 1)
+    fwd_per_token = n_layers * per_layer + 2.0 * d * vocab
+    return 3.0 * fwd_per_token * batch * seq
+
+
+from statistics import median as _median
+
+
 # --------------------------------------------------------------- subprocess
-def _run_worker(mode: str, *, force_cpu: bool,
-                timeout_s: float) -> tuple[dict | None, str]:
+def _run_worker(mode: str, *, force_cpu: bool, timeout_s: float,
+                extra_env: dict[str, str] | None = None
+                ) -> tuple[dict | None, str]:
     """Run `python bench.py --worker <mode>` and parse its last stdout line.
 
     Returns (parsed json or None, diagnostic). force_cpu adds the fake-pod
@@ -75,6 +129,8 @@ def _run_worker(mode: str, *, force_cpu: bool,
     TPU plugin — the worker enforces it via jax.config, like tests/conftest).
     """
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
         flags = env.get("XLA_FLAGS", "")
@@ -119,19 +175,64 @@ def _worker_setup():
     return jax
 
 
+def _eval_data():
+    """The held-out evaluation set BOTH FedAvg paths are scored on: the real
+    MNIST test split when files exist, else fresh draws (seed disjoint from
+    every training seed) from the same synthetic template task."""
+    from vantage6_tpu.utils import datasets as D
+
+    real = D.load_mnist(split="test")
+    if real is not None:
+        x, y = real
+        return x[:4096], y[:4096]
+    return D.synthetic_image_classes(2048, seed=777)
+
+
+def _timed_chain(jax, step, state, n: int = TIMED_RUNS):
+    """Honest steady-state timing on a runtime whose completion signals
+    cannot be trusted (BENCH_r02/r03 findings: on the tunneled TPU,
+    `block_until_ready` returns early not just for the first post-warm
+    execution but for EVERY re-execution of an identical computation —
+    apparently served from a result cache).
+
+    Defenses, in order:
+      1. every timed run has DIFFERENT inputs: `step(state, i) -> (state,
+         pull)` chains each run's inputs from the previous outputs (nothing
+         is re-executable from cache, and run i+1 cannot finish before run
+         i's real compute);
+      2. each run ends with a HOST PULL of `pull` (float()) — bytes on the
+         host cannot be faked by an early completion signal;
+      3. the first run is still discarded as warm-chain entry.
+
+    Returns (final_state, per-run seconds for the n timed runs).
+    """
+    state, pull = step(state, 0)  # discard: warm chain entry
+    float(jax.numpy.sum(pull))
+    times = []
+    for i in range(1, n + 1):
+        t0 = time.perf_counter()
+        state, pull = step(state, i)
+        float(jax.numpy.sum(pull))  # host pull: forces true completion
+        times.append(time.perf_counter() - t0)
+    return state, times
+
+
 def worker_probe() -> None:
     jax = _worker_setup()
     d = jax.devices()
-    print(json.dumps({"platform": d[0].platform, "n": len(d)}))
+    print(json.dumps({
+        "platform": d[0].platform,
+        "n": len(d),
+        "device_kind": d[0].device_kind,
+    }))
 
 
 def worker_spmd() -> None:
-    """rounds/sec of the one-program SPMD FedAvg path.
+    """rounds/sec of the one-program SPMD FedAvg path + final accuracy.
 
-    AOT: `.lower().compile()` once, then one warm execution and one timed
-    execution of the SAME executable — no second trace/compile for a
-    different round count (the round-1 bench compiled two programs and a
-    CPU run took ~25 min; this path is bounded by one compile + 2 runs)."""
+    AOT: `.lower().compile()` once, then warm + discard + TIMED_RUNS timed
+    executions of the SAME executable (median reported) — no second
+    trace/compile for a different round count."""
     jax = _worker_setup()
     import jax.numpy as jnp
 
@@ -155,42 +256,176 @@ def worker_spmd() -> None:
     t0 = time.perf_counter()
     compiled = engine._run.lower(*args, n_rounds=rounds).compile()
     compile_s = time.perf_counter() - t0
-    out = compiled(*args)  # warm run (buffer placement, autotune)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    p, _, losses = compiled(*args)
-    jax.block_until_ready(p)
-    dt = time.perf_counter() - t0
+    jax.block_until_ready(compiled(*args))  # warm (buffer placement)
+
+    def step(state, i):
+        p, o = state
+        p, o, losses = compiled(
+            p, o, sx, sy, counts, mask, jax.random.fold_in(key, 100 + i)
+        )
+        return (p, o), losses
+
+    _, times = _timed_chain(jax, step, (params, opt_state))
+    dt = _median(times)
+    # the timed chain's final params are (TIMED_RUNS + 1) * rounds deep into
+    # training; evaluate a FRESH acc-leg run from init instead so both paths
+    # are compared at the same round count
+    p_acc, _, losses = compiled(
+        params, opt_state, sx, sy, counts, mask, key
+    )
+    ex, ey = _eval_data()
+    acc = W.evaluate(p_acc, ex, ey)
     print(json.dumps({
         "rounds_per_sec": rounds / dt,
         "round_time_ms": 1e3 * dt / rounds,
         "rounds_measured": rounds,
+        "run_times_s": [round(t, 4) for t in times],
         "compile_seconds": round(compile_s, 1),
         "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
         "n_devices": len(jax.devices()),
         "final_loss": float(losses[-1]),
+        "accuracy": round(acc, 4),
+        "rounds_trained": rounds,
     }))
 
 
-def worker_baseline() -> None:
-    """Reference-shaped round: sequential stations, host serialization hops."""
+def worker_transformer() -> None:
+    """MXU-utilization metric: one federated transformer training step at an
+    MXU-friendly size (bf16 compute, f32 master weights). Tries the Pallas
+    flash-attention kernel first on TPU (BENCH_FLASH=0 disables); falls back
+    to the XLA ring path, recording the outcome either way."""
     jax = _worker_setup()
     import jax.numpy as jnp
+
+    from vantage6_tpu.workloads import fed_transformer as FT
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        d, layers, heads = TF_D, TF_LAYERS, TF_HEADS
+        seq, batch, vocab = TF_SEQ, TF_BATCH, TF_VOCAB
+    else:
+        d, layers, heads = TF_CPU["d"], TF_CPU["layers"], TF_CPU["heads"]
+        seq, batch, vocab = TF_CPU["seq"], TF_CPU["batch"], TF_CPU["vocab"]
+    # Flash (compiled Pallas) is OPT-IN on this runtime: executing any
+    # compiled pallas_call over the axon TPU tunnel wedges the tunnel
+    # machine-wide (documented in .claude/skills/verify/SKILL.md), so the
+    # default path is the XLA ring attention; set BENCH_FLASH=1 on real
+    # (non-tunneled) TPU hardware to bench the kernel.
+    want_flash = on_tpu and os.environ.get("BENCH_FLASH", "0") == "1"
+
+    def build(attention: str):
+        cfg = FT.TransformerConfig(
+            vocab=vocab, d_model=d, n_heads=heads, n_layers=layers,
+            max_len=seq,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            attention=attention,
+        )
+        eng = FT.make_engine(n_stations=1, seq_devices=1, cfg=cfg, lr=1e-3)
+        tokens = eng.shard_tokens(
+            FT.make_federated_tokens(1, batch=batch, seq_len=seq, vocab=vocab)
+        )
+        params, opt = eng.init(jax.random.key(0))
+        mask = jnp.ones(1)
+        t0 = time.perf_counter()
+        out = eng.round(params, opt, tokens, mask)  # compile + warm
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t0
+        return eng, params, opt, tokens, mask, compile_s
+
+    attention = "flash" if want_flash else "ring"
+    attn_outcome = attention
+    try:
+        eng, params, opt, tokens, mask, compile_s = build(attention)
+    except Exception as e:  # flash kernel failed to compile/run on this chip
+        if attention != "flash":
+            raise
+        attn_outcome = (
+            f"flash failed -> ring: {type(e).__name__}: {str(e)[:200]}"
+        )
+        eng, params, opt, tokens, mask, compile_s = build("ring")
+
+    def step(state, i):
+        p, o = state
+        p, o, loss = eng.round(p, o, tokens, mask)
+        return (p, o), loss
+
+    (p, opt), times = _timed_chain(jax, step, (params, opt))
+    _, _, loss = eng.round(p, opt, tokens, mask)
+    dt = _median(times)
+    flops = transformer_train_flops(d, layers, seq, batch, vocab)
+    out = {
+        "step_time_ms": round(1e3 * dt, 3),
+        "run_times_s": [round(t, 4) for t in times],
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "flops_per_step": flops,
+        "achieved_tflops": round(flops / dt / 1e12, 2),
+        "compile_seconds": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "attention": attn_outcome,
+        "flash_note": (
+            None if want_flash or not on_tpu else
+            "flash kernel not attempted: compiled pallas over the axon "
+            "tunnel wedges it machine-wide (verify SKILL.md); BENCH_FLASH=1 "
+            "enables it on real TPU hardware"
+        ),
+        "final_loss": float(loss),
+        "config": {"d_model": d, "n_layers": layers, "n_heads": heads,
+                   "seq": seq, "batch": batch, "vocab": vocab,
+                   "dtype": "bfloat16" if on_tpu else "float32"},
+    }
+    print(json.dumps(out))
+
+
+def worker_baseline() -> None:
+    """Reference-shaped rounds: sequential stations + JSON payload hops.
+
+    Timing: a full 32-station hop-instrumented round costs minutes on this
+    host, so each of the BASELINE_TIMING_ROUNDS timing rounds routes
+    BASELINE_TIMING_STATIONS stations through the complete serialize ->
+    train -> deserialize path sequentially, times them, and scales by
+    S/BASELINE_TIMING_STATIONS (per-station hop cost is independent of the
+    station index; the method is recorded in "timing_method"). This is what
+    lets the measurement honor both the >=5-rounds requirement and the time
+    budget (VERDICT r2 weak #4).
+
+    Accuracy: training runs the full reference maths for BENCH_ACC_ROUNDS
+    rounds — every round aggregates ALL stations' sequential-semantics
+    updates (executed batched via vmap: the identical per-station program
+    with the same seeds; each timing round, the first hop-instrumented
+    station is cross-checked against its batched result to loose f32
+    tolerance — vmap only reassociates floating-point reductions, it cannot
+    change the maths) — and the final model is scored on the same held-out
+    set as the SPMD worker (VERDICT r2 missing #4).
+    """
+    jax = _worker_setup()
+    import jax.numpy as jnp
+    import numpy as np
 
     from vantage6_tpu.common.serialization import deserialize, serialize
     from vantage6_tpu.workloads import fedavg_mnist as W
 
+    acc_rounds = int(os.environ.get("BENCH_ACC_ROUNDS", str(SPMD_ROUNDS_CPU)))
     cpu = jax.devices("cpu")[0]
-    x, y = W.image_classes(N_STATIONS * N_PER_STATION, seed=0)
     key = jax.random.key(0)
     with jax.default_device(cpu):
+        # SAME shards and weighting as the SPMD leg — accuracy_parity must
+        # compare IMPLEMENTATIONS, not data partitionings: Dirichlet
+        # non-iid shards, padded with true counts, count-weighted mean
+        sx_np, sy_np, counts = W.make_federated_data(
+            N_STATIONS, n_per_station=N_PER_STATION
+        )
+        sx, sy = jnp.asarray(sx_np), jnp.asarray(sy_np)
+        counts = jnp.asarray(counts)
         params = W.init_params(jax.random.fold_in(key, 1))
 
-        def local_train(params, sx, sy, seed):
+        def local_train(params, sx, sy, count, seed):
             k = jax.random.key(seed)
+            safe = jnp.maximum(count.astype(jnp.int32), 1)
 
             def step(p, sk):
-                idx = jax.random.randint(sk, (BATCH,), 0, sx.shape[0])
+                idx = jax.random.randint(sk, (BATCH,), 0, safe)
                 bx, by = jnp.take(sx, idx, axis=0), jnp.take(sy, idx, axis=0)
                 g = jax.grad(
                     lambda q: W.weighted_ce_loss(q, bx, by, jnp.ones(BATCH))
@@ -202,47 +437,103 @@ def worker_baseline() -> None:
             return out
 
         local_train = jax.jit(local_train)
-        shards = [
-            (
-                jnp.asarray(x[i * N_PER_STATION:(i + 1) * N_PER_STATION]),
-                jnp.asarray(y[i * N_PER_STATION:(i + 1) * N_PER_STATION]),
-            )
-            for i in range(N_STATIONS)
-        ]
-        jax.block_until_ready(
-            local_train(params, shards[0][0], shards[0][1], 0)
-        )
 
-        # time-boxed: up to BASELINE_ROUNDS rounds, but stop after
-        # BASELINE_MAX_S so the whole benchmark stays inside the driver's
-        # budget (each reference-shaped round costs minutes of sequential
-        # per-station work + ~140MB of payload hops on a slow host)
-        t0 = time.perf_counter()
-        done = 0
-        for r in range(BASELINE_ROUNDS):
-            results = []
-            for s, (sx, sy) in enumerate(shards):
-                # task payload hop: serialize global params -> station
-                blob = serialize({"params": params})
-                p_in = deserialize(blob)["params"]
-                p_in = jax.tree.map(jnp.asarray, p_in)
-                new_p = local_train(p_in, sx, sy, r * 1000 + s)
-                # result hop: station -> server
-                results.append(
-                    deserialize(serialize({"params": new_p}))["params"]
-                )
-            params = jax.tree.map(
-                lambda *ps: jnp.mean(
-                    jnp.stack([jnp.asarray(p) for p in ps]), axis=0
-                ),
-                *results,
+        # all-stations round for the accuracy leg: lax.map compiles the
+        # station body ONCE and loops (vmap of 32 stations took minutes of
+        # XLA compile on this host), preserving per-station sequential
+        # semantics exactly
+        @jax.jit
+        def batched_train(params, sx, sy, counts, seeds):
+            return jax.lax.map(
+                lambda t: local_train(params, t[0], t[1], t[2], t[3]),
+                (sx, sy, counts, seeds),
             )
+
+        def weighted_mean(stacked_tree):
+            wn = counts / jnp.sum(counts)
+            return jax.tree.map(
+                lambda t: jnp.einsum("s,s...->...", wn, t), stacked_tree
+            )
+
+        # warm both executables outside the timed region
+        t0 = time.perf_counter()
+        jax.block_until_ready(local_train(params, sx[0], sy[0],
+                                          counts[0], 0))
+        jax.block_until_ready(
+            batched_train(params, sx, sy, counts, jnp.arange(N_STATIONS))
+        )
+        compile_s = time.perf_counter() - t0
+
+        k_timed = BASELINE_TIMING_STATIONS
+        per_round_est: list[float] = []
+        batched_round_s: list[float] = []
+        t_start = time.perf_counter()
+        done = 0
+        for r in range(acc_rounds):
+            seeds = jnp.asarray([r * 1000 + s for s in range(N_STATIONS)])
+            if r < BASELINE_TIMING_ROUNDS:
+                # hop-instrumented sequential path for k stations, timed
+                t0 = time.perf_counter()
+                hop_results = []
+                for s in range(k_timed):
+                    blob = serialize({"params": params})
+                    p_in = deserialize(blob)["params"]
+                    p_in = jax.tree.map(jnp.asarray, p_in)
+                    new_p = local_train(
+                        p_in, sx[s], sy[s], counts[s], int(seeds[s])
+                    )
+                    hop_results.append(
+                        deserialize(serialize({"params": new_p}))["params"]
+                    )
+                jax.block_until_ready(jax.tree.leaves(hop_results[-1])[0])
+                per_round_est.append(
+                    (time.perf_counter() - t0) * N_STATIONS / k_timed
+                )
+            t0 = time.perf_counter()
+            stacked = batched_train(params, sx, sy, counts, seeds)
+            jax.block_until_ready(stacked)
+            batched_round_s.append(time.perf_counter() - t0)
+            if r < BASELINE_TIMING_ROUNDS:
+                # the hop path and the batched path are the same maths; the
+                # tolerance absorbs vmap's reassociated f32 reductions
+                # amplified over LOCAL_STEPS sgd steps
+                for a, b in zip(
+                    jax.tree.leaves(hop_results[0]),
+                    jax.tree.leaves(jax.tree.map(lambda t: t[0], stacked)),
+                ):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-2
+                    )
+            params = weighted_mean(stacked)
             jax.block_until_ready(jax.tree.leaves(params)[0])
             done = r + 1
-            if time.perf_counter() - t0 > BASELINE_MAX_S and done >= 2:
+            if (
+                time.perf_counter() - t_start > BASELINE_MAX_S
+                and len(per_round_est) >= BASELINE_TIMING_ROUNDS
+            ):
                 break
-        dt = time.perf_counter() - t0
-    print(json.dumps({"rounds_per_sec": done / dt, "rounds": done}))
+        med = _median(per_round_est)
+        t0 = time.perf_counter()
+        ex, ey = _eval_data()
+        acc = W.evaluate(params, ex, ey)
+        eval_s = time.perf_counter() - t0
+    print(json.dumps({
+        "rounds_per_sec": 1.0 / med,
+        "rounds": len(per_round_est),
+        "round_time_s_median": round(med, 2),
+        "round_time_s_all": [round(t, 2) for t in per_round_est],
+        "timing_method": (
+            f"{k_timed}-of-{N_STATIONS} stations hop-instrumented "
+            f"sequentially per round, scaled x{N_STATIONS // k_timed}"
+        ),
+        "accuracy": round(acc, 4),
+        "rounds_trained": done,
+        "phase_seconds": {
+            "compile_warm": round(compile_s, 1),
+            "batched_rounds": [round(t, 1) for t in batched_round_s],
+            "eval": round(eval_s, 1),
+        },
+    }))
 
 
 # --------------------------------------------------------------------- main
@@ -267,22 +558,32 @@ def main() -> None:
         spmd, spmd_diag = _run_worker("spmd", force_cpu=True,
                                       timeout_s=WORKER_TIMEOUT_S)
 
-    base, base_diag = _run_worker("baseline", force_cpu=True,
-                                  timeout_s=WORKER_TIMEOUT_S)
+    acc_rounds = str(spmd["rounds_trained"]) if spmd else str(SPMD_ROUNDS_CPU)
+    base, base_diag = _run_worker(
+        "baseline", force_cpu=True, timeout_s=WORKER_TIMEOUT_S,
+        extra_env={"BENCH_ACC_ROUNDS": acc_rounds},
+    )
 
     flops_round = cnn_train_flops_per_round()
     out["model_flops_per_round"] = flops_round
+    out["timing_valid"] = True
     if spmd is not None:
         rps = spmd["rounds_per_sec"]
         out["value"] = round(rps, 3)
         out["platform"] = spmd["platform"]
+        out["device_kind"] = spmd.get("device_kind")
         out["n_devices"] = spmd["n_devices"]
         out["round_time_ms"] = round(spmd["round_time_ms"], 3)
+        out["run_times_s"] = spmd.get("run_times_s")
         achieved = rps * flops_round
         out["achieved_flops_per_sec"] = round(achieved, 1)
+        out["accuracy_tpu_path"] = spmd.get("accuracy")
         if spmd["platform"] == "tpu":
             peak = V5E_BF16_PEAK_FLOPS * spmd["n_devices"]
-            out["mfu_vs_v5e_bf16_peak"] = round(achieved / peak, 6)
+            mfu = achieved / peak
+            out["mfu_vs_v5e_bf16_peak"] = round(mfu, 6)
+            if mfu > 1.0:  # physically impossible => the timing is wrong
+                out["timing_valid"] = False
         else:
             out["mfu_vs_v5e_bf16_peak"] = None  # no defined CPU peak
     else:
@@ -291,12 +592,62 @@ def main() -> None:
     if base is not None:
         out["baseline_rounds_per_sec"] = round(base["rounds_per_sec"], 4)
         out["baseline_rounds"] = base["rounds"]
+        out["baseline_timing_method"] = base.get("timing_method")
+        out["accuracy_baseline_path"] = base.get("accuracy")
         if spmd is not None:
             out["vs_baseline"] = round(
                 spmd["rounds_per_sec"] / base["rounds_per_sec"], 2
             )
+            if (
+                spmd.get("accuracy") is not None
+                and base.get("accuracy") is not None
+                and spmd.get("rounds_trained") == base.get("rounds_trained")
+            ):
+                gap = abs(spmd["accuracy"] - base["accuracy"])
+                out["accuracy_gap"] = round(gap, 4)
+                out["accuracy_parity"] = bool(gap <= ACC_TOLERANCE)
     else:
         out["baseline_error"] = base_diag
+
+    # ---- MXU utilization metric (transformer) -------------------------
+    tf, tf_diag = _run_worker(
+        "transformer", force_cpu=not tpu_ok, timeout_s=WORKER_TIMEOUT_S
+    )
+    if tf is None and tpu_ok and os.environ.get("BENCH_FLASH") == "1":
+        # the flash attempt may have crashed the worker outright; retry
+        # with the kernel disabled before falling back to CPU (pointless
+        # when flash was never enabled — same env would just rerun)
+        tf, tf_diag = _run_worker(
+            "transformer", force_cpu=False, timeout_s=WORKER_TIMEOUT_S,
+            extra_env={"BENCH_FLASH": "0"},
+        )
+        if tf is not None:
+            tf["attention"] = f"flash worker died ({tf_diag}); reran ring"
+    if tf is None and tpu_ok:
+        # TPU attempt(s) failed: degrade to CPU (when the first attempt was
+        # already force_cpu, rerunning the identical config is pointless)
+        tf, tf_diag = _run_worker(
+            "transformer", force_cpu=True, timeout_s=WORKER_TIMEOUT_S,
+            extra_env={"BENCH_FLASH": "0"},
+        )
+    if tf is not None:
+        out["transformer_step_time_ms"] = tf["step_time_ms"]
+        out["transformer_tokens_per_sec"] = tf["tokens_per_sec"]
+        out["transformer_achieved_tflops"] = tf["achieved_tflops"]
+        out["transformer_attention"] = tf["attention"]
+        out["transformer_config"] = tf["config"]
+        out["transformer_platform"] = tf["platform"]
+        if tf["platform"] == "tpu":
+            tf_mfu = tf["flops_per_step"] / (
+                tf["step_time_ms"] / 1e3
+            ) / V5E_BF16_PEAK_FLOPS
+            out["transformer_mfu_vs_v5e_bf16_peak"] = round(tf_mfu, 4)
+            if tf_mfu > 1.0:
+                out["timing_valid"] = False
+        else:
+            out["transformer_mfu_vs_v5e_bf16_peak"] = None
+    else:
+        out["transformer_error"] = tf_diag
 
     print(json.dumps(out))
     sys.exit(0 if spmd is not None else 1)
@@ -306,6 +657,7 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         {"probe": worker_probe,
          "spmd": worker_spmd,
-         "baseline": worker_baseline}[sys.argv[2]]()
+         "baseline": worker_baseline,
+         "transformer": worker_transformer}[sys.argv[2]]()
     else:
         main()
