@@ -1,0 +1,132 @@
+"""Multi-host / multi-slice federation bootstrap (DCN scale-out).
+
+The reference scales out with more node daemons over HTTPS (SURVEY.md
+§2.4); the TPU-native data plane scales out with more PROCESSES over DCN:
+each host (one process per TPU slice, or per machine on CPU) initializes
+the JAX coordination service, after which ``jax.devices()`` is the GLOBAL
+device list and one ``FederationMesh`` spans every slice — XLA routes
+collectives over ICI within a slice and DCN across slices, exactly the
+"mesh axes ride the fastest fabric" recipe of the scaling playbook.
+
+Deployment contract (mirrors how real vantage6 stations hold only their own
+data): every process loads ONLY the shards of the stations it hosts;
+``stack_local_shards`` assembles the global station-stacked array from the
+per-process pieces without any host ever holding another host's rows.
+
+Works identically on a laptop: ``initialize()`` with no configuration is a
+no-op single-process setup, and the same code runs on the in-process mesh.
+Tested with real multi-process CPU collectives (Gloo) in
+tests/test_distributed.py.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from vantage6_tpu.core.mesh import FederationMesh
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> bool:
+    """Join (or skip) the multi-process coordination service. Idempotent.
+
+    Resolution order per field: explicit argument > environment
+    (``V6T_COORDINATOR``, ``V6T_NUM_PROCESSES``, ``V6T_PROCESS_ID``) >
+    JAX's own cluster auto-detection (TPU pods detect themselves; beyond
+    that jax.distributed.initialize() figures out slurm & friends).
+
+    Returns True when running multi-process, False for plain single-process
+    (no configuration found — the local/simulation mode).
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator_address = coordinator_address or os.environ.get(
+        "V6T_COORDINATOR"
+    )
+    if num_processes is None and os.environ.get("V6T_NUM_PROCESSES"):
+        num_processes = int(os.environ["V6T_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("V6T_PROCESS_ID"):
+        process_id = int(os.environ["V6T_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # single-process mode: nothing to join
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def global_mesh(
+    n_stations: int, devices_per_station: int = 1
+) -> FederationMesh:
+    """A FederationMesh over the GLOBAL device list (all processes).
+
+    Call after ``initialize()``. Single-process, this is exactly
+    ``FederationMesh(n_stations, ...)``.
+    """
+    return FederationMesh(
+        n_stations,
+        devices=jax.devices(),
+        devices_per_station=devices_per_station,
+    )
+
+
+def local_stations(mesh: FederationMesh) -> list[int]:
+    """The station indices THIS process hosts (owns the devices of).
+
+    Station i lives in station-axis slot ``i // stations_per_slot``
+    (contiguous blocks — the fed_map packing contract); a slot belongs to
+    the process owning its first device.
+    """
+    me = jax.process_index()
+    spp = mesh.stations_per_slot
+    out = []
+    for i in range(mesh.n_stations):
+        slot = i // spp
+        if mesh.mesh.devices[slot, 0].process_index == me:
+            out.append(i)
+    return out
+
+
+def stack_local_shards(
+    mesh: FederationMesh,
+    shards: Mapping[int, np.ndarray] | Sequence[np.ndarray],
+    dtype: Any = None,
+) -> jax.Array:
+    """Build the global ``[S, ...]`` station-stacked array from THIS
+    process's shards only.
+
+    ``shards`` maps station index -> that station's (padded) array, and
+    must cover exactly ``local_stations(mesh)`` — each host contributes its
+    own stations; no host ever materializes another host's rows. (A plain
+    sequence is accepted single-process, where local == all.)
+    """
+    mine = local_stations(mesh)
+    if not isinstance(shards, Mapping):
+        shards = dict(enumerate(shards))
+    missing = [i for i in mine if i not in shards]
+    extra = [i for i in shards if i not in mine]
+    if missing or extra:
+        raise ValueError(
+            f"process {jax.process_index()} hosts stations {mine}; shards "
+            f"missing {missing}, not-local {extra} — every process passes "
+            "exactly its own stations' data"
+        )
+    local = np.stack([np.asarray(shards[i], dtype=dtype) for i in mine])
+    return jax.make_array_from_process_local_data(
+        mesh.station_sharding(), local
+    )

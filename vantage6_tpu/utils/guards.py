@@ -1,0 +1,35 @@
+"""Transfer guards: the TPU-world analogue of race/sanitizer checks.
+
+SURVEY.md §5 maps the reference's (absent) race detection to "jax
+transfer-guard / donation checks" here: the federated hot loop must be
+device-resident — an implicit host→device transfer inside a round means
+some array silently fell off the mesh (a performance bug at best, a
+stale-host-copy correctness bug at worst). Wrap round loops in
+``no_implicit_transfers()`` in tests/benchmarks to make that a hard error
+instead of a silent HBM↔host round trip.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def no_implicit_transfers() -> Iterator[None]:
+    """Raise on any IMPLICIT host<->device transfer inside the block.
+
+    Explicit movement (`jax.device_put`, `np.asarray(x)`, `.block_until_ready`
+    on results you then pull) stays allowed — the guard targets the silent
+    transfers jit tracing inserts when an operand lives on the wrong side.
+    """
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def log_transfers() -> Iterator[None]:
+    """Diagnostic mode: report implicit transfers without failing."""
+    with jax.transfer_guard("log"):
+        yield
