@@ -258,18 +258,25 @@ def worker_spmd() -> None:
     compile_s = time.perf_counter() - t0
     jax.block_until_ready(compiled(*args))  # warm (buffer placement)
 
+    # several chained executions per timed run: the per-run host pull costs
+    # a ~50 ms tunnel round-trip, which would inflate a 5-round (~180 ms)
+    # measurement by ~25%
+    execs_per_run = 4 if on_tpu else 1
+
     def step(state, i):
         p, o = state
-        p, o, losses = compiled(
-            p, o, sx, sy, counts, mask, jax.random.fold_in(key, 100 + i)
-        )
+        for j in range(execs_per_run):
+            p, o, losses = compiled(
+                p, o, sx, sy, counts, mask,
+                jax.random.fold_in(key, 100 + execs_per_run * i + j),
+            )
         return (p, o), losses
 
     _, times = _timed_chain(jax, step, (params, opt_state))
-    dt = _median(times)
-    # the timed chain's final params are (TIMED_RUNS + 1) * rounds deep into
-    # training; evaluate a FRESH acc-leg run from init instead so both paths
-    # are compared at the same round count
+    dt = _median(times) / execs_per_run
+    # the timed chain's final params are (TIMED_RUNS + 1) * execs_per_run *
+    # rounds deep into training; evaluate a FRESH acc-leg run from init
+    # instead so both paths are compared at the same round count
     p_acc, _, losses = compiled(
         params, opt_state, sx, sy, counts, mask, key
     )
@@ -345,14 +352,19 @@ def worker_transformer() -> None:
         )
         eng, params, opt, tokens, mask, compile_s = build("ring")
 
+    # several chained steps per timed run: the per-run host pull costs a
+    # tunnel round-trip, which would inflate a single ~100ms step by ~10%
+    steps_per_run = 4 if on_tpu else 1
+
     def step(state, i):
         p, o = state
-        p, o, loss = eng.round(p, o, tokens, mask)
+        for _ in range(steps_per_run):
+            p, o, loss = eng.round(p, o, tokens, mask)
         return (p, o), loss
 
     (p, opt), times = _timed_chain(jax, step, (params, opt))
     _, _, loss = eng.round(p, opt, tokens, mask)
-    dt = _median(times)
+    dt = _median(times) / steps_per_run
     flops = transformer_train_flops(d, layers, seq, batch, vocab)
     out = {
         "step_time_ms": round(1e3 * dt, 3),

@@ -62,8 +62,9 @@ _CHILD = textwrap.dedent(
 )
 
 
-@pytest.mark.parametrize("n_procs", [2])
-def test_two_process_federated_mean(tmp_path, n_procs):
+def _spawn_children(tmp_path, n_procs):
+    """One attempt: pick a free port (bind/close — inherently racy, see
+    caller) and run the children to completion."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -87,16 +88,29 @@ def test_two_process_federated_mean(tmp_path, n_procs):
         )
         for i in range(n_procs)
     ]
-    outs = []
+    results = []
     for p in procs:
         try:
             out, err = p.communicate(timeout=240)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("distributed child timed out")
-        assert p.returncode == 0, err[-2000:]
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+            return None, "timeout"
+        if p.returncode != 0:
+            return None, err[-2000:]
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    return results, ""
+
+
+@pytest.mark.parametrize("n_procs", [2])
+def test_two_process_federated_mean(tmp_path, n_procs):
+    # the free-port probe (bind/close) is a TOCTOU race on a busy host —
+    # another process can grab the port before the child coordinator binds
+    # it; one retry with a fresh port absorbs that flake
+    outs, why = _spawn_children(tmp_path, n_procs)
+    if outs is None:
+        outs, why = _spawn_children(tmp_path, n_procs)
+    assert outs is not None, why
 
     n_stations = outs[0]["global_devices"]
     # oracle: station s holds s..s+3

@@ -36,16 +36,21 @@ def initialize(
     num_processes: int | None = None,
     process_id: int | None = None,
     local_device_ids: Sequence[int] | None = None,
+    auto: bool = False,
 ) -> bool:
     """Join (or skip) the multi-process coordination service. Idempotent.
 
     Resolution order per field: explicit argument > environment
-    (``V6T_COORDINATOR``, ``V6T_NUM_PROCESSES``, ``V6T_PROCESS_ID``) >
-    JAX's own cluster auto-detection (TPU pods detect themselves; beyond
-    that jax.distributed.initialize() figures out slurm & friends).
+    (``V6T_COORDINATOR``, ``V6T_NUM_PROCESSES``, ``V6T_PROCESS_ID``).
+    With NO configuration found, the default is plain single-process local
+    mode (returns False, no side effects) — pass ``auto=True`` on managed
+    clusters (TPU pods, slurm, GKE) to hand detection to
+    ``jax.distributed.initialize()``'s cluster plugins instead; auto mode
+    raises if no cluster is detected rather than silently running
+    single-process (each host training a disjoint federation is exactly
+    the failure this guards against).
 
-    Returns True when running multi-process, False for plain single-process
-    (no configuration found — the local/simulation mode).
+    Returns True when running multi-process, False for single-process.
     """
     global _initialized
     if _initialized:
@@ -58,14 +63,17 @@ def initialize(
     if process_id is None and os.environ.get("V6T_PROCESS_ID"):
         process_id = int(os.environ["V6T_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
-        # single-process mode: nothing to join
-        return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+        if not auto:
+            # single-process mode: nothing to join
+            return False
+        jax.distributed.initialize()  # cluster plugins; raises if none
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
     _initialized = True
     return jax.process_count() > 1
 
@@ -116,6 +124,14 @@ def stack_local_shards(
     sequence is accepted single-process, where local == all.)
     """
     mine = local_stations(mesh)
+    if not mine:
+        raise ValueError(
+            f"process {jax.process_index()} hosts NO stations: the mesh "
+            f"uses {mesh.station_axis_size * mesh.devices_per_station} of "
+            "the global devices and none of this process's devices made "
+            "the cut — size n_stations/devices_per_station so every "
+            "process owns at least one station slot"
+        )
     if not isinstance(shards, Mapping):
         shards = dict(enumerate(shards))
     missing = [i for i in mine if i not in shards]
