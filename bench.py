@@ -67,8 +67,9 @@ ACC_TOLERANCE = 0.05    # |acc_spmd - acc_baseline| for "accuracy_parity"
 # against the bf16 peak as the honest *upper* reference either way).
 V5E_BF16_PEAK_FLOPS = 1.97e14
 
-# MXU-friendly transformer bench shape (single chip).
-TF_D, TF_LAYERS, TF_HEADS, TF_SEQ, TF_BATCH, TF_VOCAB = 1024, 8, 8, 1024, 8, 4096
+# MXU-friendly transformer bench shape (single chip). Batch 16 measured
+# best on the v5e (B8: 34.5% MFU, B16: 37.7%, B32: OOM).
+TF_D, TF_LAYERS, TF_HEADS, TF_SEQ, TF_BATCH, TF_VOCAB = 1024, 8, 8, 1024, 16, 4096
 # CPU fallback shape: just proves the path runs; no MFU claim.
 TF_CPU = dict(d=64, layers=2, heads=2, seq=128, batch=2, vocab=256)
 
@@ -311,6 +312,8 @@ def worker_transformer() -> None:
     if on_tpu:
         d, layers, heads = TF_D, TF_LAYERS, TF_HEADS
         seq, batch, vocab = TF_SEQ, TF_BATCH, TF_VOCAB
+        batch = int(os.environ.get("BENCH_TF_BATCH", batch))
+        seq = int(os.environ.get("BENCH_TF_SEQ", seq))
     else:
         d, layers, heads = TF_CPU["d"], TF_CPU["layers"], TF_CPU["heads"]
         seq, batch, vocab = TF_CPU["seq"], TF_CPU["batch"], TF_CPU["vocab"]

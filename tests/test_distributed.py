@@ -62,14 +62,14 @@ _CHILD = textwrap.dedent(
 )
 
 
-def _spawn_children(tmp_path, n_procs):
+def _spawn_children(tmp_path, n_procs, source=None, timeout=240):
     """One attempt: pick a free port (bind/close — inherently racy, see
     caller) and run the children to completion."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     script = tmp_path / "child.py"
-    script.write_text(_CHILD)
+    script.write_text(source if source is not None else _CHILD)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {
         **os.environ,
@@ -91,7 +91,7 @@ def _spawn_children(tmp_path, n_procs):
     results = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -141,3 +141,70 @@ def test_single_process_initialize_is_noop(monkeypatch):
 
     with pytest.raises(ValueError, match="exactly its own stations"):
         D.stack_local_shards(mesh, {0: np.ones(3, np.float32)})
+
+
+_CHILD_FEDAVG = textwrap.dedent(
+    """
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from vantage6_tpu.core import distributed as D
+
+    assert D.initialize(coordinator_address=f"127.0.0.1:{port}",
+                        num_processes=n, process_id=pid)
+
+    import jax.numpy as jnp
+    from vantage6_tpu.workloads import fedavg_mnist as W
+
+    mesh = D.global_mesh(n_stations=jax.device_count())
+    S = mesh.n_stations
+    engine = W.make_engine(mesh, local_steps=2, batch_size=4, local_lr=0.1)
+
+    # every process generates ONLY its own stations' shards (the same
+    # deterministic per-station stream on any host)
+    mine = D.local_stations(mesh)
+    def shard(s):
+        x, y = W.image_classes(8, seed=1000 + s)
+        return x, y
+    sx = D.stack_local_shards(mesh, {s: shard(s)[0] for s in mine})
+    sy = D.stack_local_shards(mesh, {s: shard(s)[1] for s in mine})
+    counts = jax.device_put(
+        jnp.full((S,), 8.0), mesh.replicated_sharding()
+    )
+
+    params = W.init_params(jax.random.key(0))
+    opt = engine.init(params)
+    params, opt, loss = engine.round(
+        params, opt, sx, sy, counts, jax.random.key(1)
+    )
+    jax.block_until_ready(params)
+    leaf = np.asarray(jax.tree.leaves(params)[0]).ravel()[:4]
+    print(json.dumps({
+        "pid": pid,
+        "loss": float(loss),
+        "leaf": [float(v) for v in leaf],
+    }))
+    """
+)
+
+
+def test_two_process_fedavg_round(tmp_path):
+    """The FULL FedAvg engine — per-station local SGD under fed_map +
+    weighted aggregation — as one SPMD program spanning two REAL processes
+    (Gloo collectives over the loopback 'DCN'). Both processes must agree
+    on the aggregated model bit-for-bit."""
+    outs, err = _spawn_children(
+        tmp_path, 2, source=_CHILD_FEDAVG, timeout=300
+    )
+    if outs is None:  # port-probe TOCTOU retry, as above
+        outs, err = _spawn_children(
+            tmp_path, 2, source=_CHILD_FEDAVG, timeout=300
+        )
+    assert outs is not None, err
+    assert np.isfinite(outs[0]["loss"])
+    # the aggregate is REPLICATED: both hosts hold the identical model
+    assert outs[0]["loss"] == outs[1]["loss"]
+    assert outs[0]["leaf"] == outs[1]["leaf"]

@@ -650,3 +650,68 @@ class TestContainerJobScoping:
         rid = nc.get(f"/api/run?task_id={t['id']}").json["data"][0]["id"]
         assert nc.patch(f"/api/run/{rid}", {"status": "bogus"}).status == 400
         assert nc.patch(f"/api/run/{rid}", {"status": "active"}).status == 200
+
+
+class TestSessionReadiness:
+    """A session dataframe is 'ready' only once EVERY node of its
+    (re)building task has completed — the first reporter must not flip it
+    while peers are still extracting."""
+
+    def test_ready_requires_all_runs_completed(self, srv, seeded):
+        from vantage6_tpu.common.enums import TaskStatus
+
+        c = seeded["client"]
+        collab = seeded["collab"]
+        s = c.post(
+            "/api/session",
+            {"name": "rd", "collaboration_id": collab["id"]},
+        ).json
+        task = c.post(
+            "/api/task",
+            {
+                "image": "algo",
+                "collaboration_id": collab["id"],
+                "organizations": [
+                    {"id": o["id"], "input": ""} for o in seeded["orgs"]
+                ],
+                "session_id": s["id"],
+                "store_as": "prep",
+            },
+        ).json
+        assert task["store_as"] == "prep"
+        runs = [
+            m.TaskRun.get(rid)
+            for rid in [
+                r["id"]
+                for r in c.get(f"/api/task/{task['id']}/run").json["data"]
+            ]
+        ]
+        n0, _ = node_login(srv, seeded["api_keys"][0])
+        n1, _ = node_login(srv, seeded["api_keys"][1])
+
+        # node 0 completes ITS run and reports — peers still pending
+        runs[0].status = TaskStatus.COMPLETED.value
+        runs[0].save()
+        r = n0.open(
+            "PATCH",
+            f"/api/session/{s['id']}/dataframe/prep",
+            {"ready": True, "columns": [{"name": "age", "dtype": "f8"}]},
+        )
+        assert r.status == 200
+        assert r.json["ready"] is False  # peer run not finished
+
+        # node 1 completes and reports — NOW it flips
+        runs[1].status = TaskStatus.COMPLETED.value
+        runs[1].save()
+        r = n1.open(
+            "PATCH", f"/api/session/{s['id']}/dataframe/prep",
+            {"ready": True},
+        )
+        assert r.json["ready"] is True
+
+        # users may not report dataframe state
+        r = c.open(
+            "PATCH", f"/api/session/{s['id']}/dataframe/prep",
+            {"ready": True},
+        )
+        assert r.status == 403

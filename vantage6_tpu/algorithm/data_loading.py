@@ -117,6 +117,11 @@ def load_data(
         return _load_sparql(db)
     if kind == "omop":
         return _load_omop(db)
+    if kind == "session":
+        # a dataframe an earlier task materialized in this node's session
+        # store (node.runner resolves the handle to a local pickle path;
+        # reference v4.7 'sessions')
+        return _pandas().read_pickle(db.uri)
     raise ValueError(f"unknown database type {kind!r}")
 
 

@@ -58,6 +58,7 @@ class UserClient:
         self.role = SubClient(self, "role")
         self.rule = SubClient(self, "rule")
         self.study = SubClient(self, "study")
+        self.session = SessionSubClient(self)
         self.util = UtilSubClient(self)
 
     # ------------------------------------------------------------------ http
@@ -203,6 +204,8 @@ class TaskSubClient(SubClient):
         input_: dict[str, Any] | None = None,
         databases: list[dict[str, Any]] | None = None,
         study: int | None = None,
+        session: int | None = None,
+        store_as: str | None = None,
     ) -> dict[str, Any]:
         """Create a task; `input_` is the reference wire shape
         ``{"method", "args", "kwargs"}``, serialized then encrypted per
@@ -249,10 +252,27 @@ class TaskSubClient(SubClient):
         }
         if study is not None:
             body["study_id"] = study
+        if session is not None:
+            body["session_id"] = session
+        if store_as is not None:
+            body["store_as"] = store_as
         return self.parent.request("POST", "task", body)
 
     def kill(self, task_id: int) -> dict[str, Any]:
         return self.parent.request("POST", "kill/task", {"task_id": task_id})
+
+
+class SessionSubClient(SubClient):
+    """Session workspaces (reference v4.7+): named dataframes persisted AT
+    THE NODES between tasks — create a session, run an extraction task with
+    ``store_as``, then point later tasks' databases at
+    ``{"label": ..., "type": "session", "dataframe": <handle>}``."""
+
+    def __init__(self, parent: UserClient):
+        super().__init__(parent, "session")
+
+    def dataframes(self, session_id: int) -> list[dict[str, Any]]:
+        return self.parent.paginate(f"session/{session_id}/dataframe")
 
 
 class RunSubClient(SubClient):

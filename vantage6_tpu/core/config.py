@@ -43,11 +43,14 @@ class DatabaseConfig:
     """One data source at a station (reference: node config `databases:`)."""
 
     label: str
-    type: str = "csv"  # csv | parquet | excel | sql | sparql | omop | array
+    type: str = "csv"  # csv | parquet | excel | sql | sparql | omop | array | session
     uri: str = ""
     options: dict[str, Any] = dataclasses.field(default_factory=dict)
 
-    _KNOWN_TYPES = {"csv", "parquet", "excel", "sql", "sparql", "omop", "array"}
+    _KNOWN_TYPES = {
+        "csv", "parquet", "excel", "sql", "sparql", "omop", "array",
+        "session",  # a session-store dataframe (node-resolved local path)
+    }
 
     def validate(self) -> None:
         if not self.label:

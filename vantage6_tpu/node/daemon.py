@@ -188,6 +188,7 @@ class NodeDaemon:
             "cursor"
         ]
         self._sync_missed_runs()
+        self._reconcile_sessions()
         if background:
             self._thread = threading.Thread(target=self._listen, daemon=True)
             self._thread.start()
@@ -285,6 +286,9 @@ class NodeDaemon:
                 self._submit(data["run_id"])
         elif name == "kill-task":
             self._killed.add(data.get("run_id"))
+        elif name == "session-deleted" and data.get("session_id"):
+            # drop the LOCAL dataframe store for the deleted workspace
+            self.runner.drop_session(data["session_id"])
 
     def _submit(self, run_id: int) -> None:
         with self._claim_lock:
@@ -321,6 +325,33 @@ class NodeDaemon:
             if page * 250 >= total or not body["data"]:
                 return
             page += 1
+
+    def _reconcile_sessions(self) -> None:
+        """Drop local session stores whose server session no longer exists.
+
+        The SESSION_DELETED event only reaches connected nodes; a node
+        offline at deletion time would otherwise keep extracted (possibly
+        sensitive) dataframes on disk forever. A 404 probe per locally
+        stored session closes that gap at every (re)start.
+        """
+        from vantage6_tpu.common.rest import RestError
+
+        for d in self.runner.work_dir.glob("session_*"):
+            try:
+                sid = int(d.name.split("_", 1)[1])
+            except ValueError:
+                continue
+            try:
+                self.request("GET", f"session/{sid}")
+            except RestError as e:
+                if e.status == 404:
+                    log.info(
+                        "session %s deleted while offline; dropping store",
+                        sid,
+                    )
+                    self.runner.drop_session(sid)
+            except Exception as e:
+                log.warning("session %s reconcile probe failed: %s", sid, e)
 
     # --------------------------------------------------------------- execute
     def _execute(self, run_id: int) -> None:
@@ -378,6 +409,7 @@ class NodeDaemon:
                 "token/container",
                 {"task_id": task["id"], "image": task["image"]},
             )["container_token"]
+            session = task.get("session") or {}
             spec = RunSpec(
                 run_id=run_id,
                 task_id=task["id"],
@@ -385,6 +417,8 @@ class NodeDaemon:
                 method=payload.get("method", task["method"]),
                 input_payload=payload,
                 databases=task.get("databases") or [],
+                session_id=session.get("id"),
+                store_as=task.get("store_as"),
                 token=token,
                 server_url=(
                     self._proxy_server.url if self._proxy_server else ""
@@ -448,6 +482,21 @@ class NodeDaemon:
                 + traceback.format_exc(limit=4),
                 finished_at=time.time(),
             )
+            return
+        if spec.store_as and isinstance(result, dict) and result.get("stored"):
+            # session bookkeeping only (the dataframe stayed local); a
+            # failed report must not fail the COMPLETED run
+            try:
+                self.request(
+                    "PATCH",
+                    f"session/{spec.session_id}/dataframe/{spec.store_as}",
+                    {"ready": True, "columns": result.get("columns") or []},
+                )
+            except Exception as e:
+                log.warning(
+                    "session dataframe report failed for run %s: %s",
+                    run_id, e,
+                )
 
     # --------------------------------------------------------------- health
     def ping(self) -> None:

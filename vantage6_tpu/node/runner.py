@@ -51,6 +51,10 @@ class RunSpec:
     token: str = ""  # container token for subtask creation
     server_url: str = ""  # proxy URL the algorithm should talk to
     metadata: dict[str, Any] = field(default_factory=dict)
+    # sessions (reference v4.7+): this run executes inside a session
+    # workspace; store_as persists the returned dataframe locally
+    session_id: int | None = None
+    store_as: str | None = None
 
 
 class TaskRunner:
@@ -139,6 +143,53 @@ class TaskRunner:
             return []
         return [int(p) for p in getattr(mod, "EXPOSED_PORTS", []) or []]
 
+    # ------------------------------------------------------------- sessions
+    def session_dir(self, session_id: int) -> Path:
+        """This node's LOCAL store for one session's dataframes (reference
+        v4.7 'sessions': dataframes persist at the station between tasks
+        and never travel)."""
+        d = self.work_dir / f"session_{int(session_id)}"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def session_file(self, session_id: int, handle: str) -> Path:
+        safe = "".join(c for c in handle if c.isalnum() or c in "-_")
+        if safe != handle or not safe:
+            raise PolicyViolation(f"invalid session dataframe handle {handle!r}")
+        return self.session_dir(session_id) / f"{safe}.pkl"
+
+    def drop_session(self, session_id: int) -> None:
+        """Delete the whole local store (server session deleted)."""
+        import shutil
+
+        d = self.work_dir / f"session_{int(session_id)}"
+        if d.exists():
+            shutil.rmtree(d, ignore_errors=True)
+
+    def _store_session_result(self, spec: RunSpec, result: Any) -> Any:
+        """Persist a store_as run's dataframe locally; upload METADATA only."""
+        import pandas as pd
+
+        df = result
+        if isinstance(df, dict) and "dataframe" in df:
+            df = df["dataframe"]
+        if not isinstance(df, pd.DataFrame):
+            raise RuntimeError(
+                f"task stores dataframe {spec.store_as!r} but the algorithm "
+                f"returned {type(result).__name__}, not a DataFrame"
+            )
+        path = self.session_file(spec.session_id, spec.store_as)
+        df.to_pickle(path)
+        return {
+            "stored": spec.store_as,
+            "session_id": spec.session_id,
+            "rows": int(len(df)),
+            "columns": [
+                {"name": str(c), "dtype": str(t)}
+                for c, t in df.dtypes.items()
+            ],
+        }
+
     # ----------------------------------------------------------------- run
     def run(self, spec: RunSpec) -> Any:
         """Execute one run; returns the (plaintext) result object.
@@ -148,9 +199,15 @@ class TaskRunner:
         """
         self.check_policy(spec.image, spec.metadata.get("init_user"))
         module = self.resolve(spec.image)
+        if spec.store_as and spec.session_id is None:
+            raise RuntimeError("store_as requires a session_id")
         if self.mode == "inline":
-            return self._run_inline(module, spec)
-        return self._run_sandbox(module, spec)
+            result = self._run_inline(module, spec)
+        else:
+            result = self._run_sandbox(module, spec)
+        if spec.store_as:
+            return self._store_session_result(spec, result)
+        return result
 
     # ------------------------------------------------------------ inline
     def _run_inline(self, module: str, spec: RunSpec) -> Any:
@@ -173,7 +230,7 @@ class TaskRunner:
             )
         frames = [
             load_data(
-                DatabaseConfig(**self._db_config(d)),
+                DatabaseConfig(**self._db_config(d, spec.session_id)),
                 whitelist=self.egress,
                 ssh_tunnels=self.ssh_tunnels,
             )
@@ -264,13 +321,13 @@ class TaskRunner:
             env["V6T_SSH_TUNNELS"] = json.dumps(
                 list(self.ssh_tunnels.tunnels.values())
             )
-        labels = [
-            d.get("label", "default")
-            for d in (spec.databases or [{"label": "default"}])
-        ]
-        env["USER_REQUESTED_DATABASE_LABELS"] = ",".join(labels)
-        for label in labels:
-            cfg = self._db_config({"label": label})
+        requested = spec.databases or [{"label": "default"}]
+        env["USER_REQUESTED_DATABASE_LABELS"] = ",".join(
+            d.get("label", "default") for d in requested
+        )
+        for d in requested:
+            label = d.get("label", "default")
+            cfg = self._db_config(d, spec.session_id)
             env[f"DATABASE_{label.upper()}_URI"] = str(cfg.get("uri", ""))
             env[f"DATABASE_{label.upper()}_TYPE"] = str(cfg.get("type", "csv"))
             env[f"DATABASE_{label.upper()}_OPTIONS"] = json.dumps(
@@ -307,8 +364,31 @@ class TaskRunner:
         return deserialize(output_file.read_bytes())
 
     # ----------------------------------------------------------------- util
-    def _db_config(self, requested: dict[str, Any]) -> dict[str, Any]:
+    def _db_config(
+        self, requested: dict[str, Any], session_id: int | None = None
+    ) -> dict[str, Any]:
         label = requested.get("label", "default")
+        if requested.get("type") == "session":
+            # session dataframe reference: resolve to this node's LOCAL
+            # session store (materialized by an earlier store_as task)
+            handle = requested.get("dataframe") or label
+            if session_id is None:
+                raise KeyError(
+                    f"database {label!r} references session dataframe "
+                    f"{handle!r} but the task carries no session"
+                )
+            path = self.session_file(session_id, handle)
+            if not path.exists():
+                raise KeyError(
+                    f"session {session_id} has no materialized dataframe "
+                    f"{handle!r} at this node (did its extraction task run?)"
+                )
+            return {
+                "label": label,
+                "type": "session",
+                "uri": str(path),
+                "options": {},
+            }
         cfg = self.databases.get(label)
         if cfg is None:
             raise KeyError(

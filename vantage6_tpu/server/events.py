@@ -25,6 +25,7 @@ TASK_CREATED = "task-created"
 STATUS_UPDATE = "status-update"
 KILL_TASK = "kill-task"
 PING = "ping"
+SESSION_DELETED = "session-deleted"  # nodes drop their local session store
 
 
 def collaboration_room(collaboration_id: int) -> str:

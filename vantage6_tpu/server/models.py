@@ -290,6 +290,9 @@ class Task(Model):
         "init_user_id": "int",
         "databases": "json",
         "job_id": "int",  # groups a task tree (reference: run_id/job_id)
+        "session_id": "int",  # sessions: task runs inside this workspace
+        "store_as": "str",    # sessions: nodes persist the run's returned
+                              # dataframe under this handle
     }
 
     def runs(self) -> list["TaskRun"]:
@@ -334,6 +337,8 @@ class Task(Model):
             "init_user": {"id": self.init_user_id},
             "job_id": self.job_id,
             "databases": self.databases or [],
+            "session": {"id": self.session_id} if self.session_id else None,
+            "store_as": self.store_as or None,
             "runs": [r.id for r in self.runs()],
         }
 
@@ -371,6 +376,65 @@ class TaskRun(Model):
         if include_result:
             d["result"] = self.result
         return d
+
+
+class Session(Model):
+    """A workspace persisting named dataframes AT THE NODES between tasks
+    (reference: v4.7+ 'sessions' — data-extraction tasks materialize
+    dataframes once; later preprocessing/compute tasks reuse them without
+    re-reading the source databases). The server stores ONLY bookkeeping;
+    dataframe content never leaves its node."""
+
+    TABLE = "session"
+    COLUMNS = {
+        "name": "str",
+        "collaboration_id": "int",
+        "study_id": "int",
+        "owner_id": "int",  # creating user
+        "scope": "str",     # "own" | "collaboration" — who may use it
+    }
+
+    def dataframes(self) -> list["SessionDataframe"]:
+        return SessionDataframe.list(session_id=self.id)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "collaboration": {"id": self.collaboration_id},
+            "study": {"id": self.study_id} if self.study_id else None,
+            "owner": {"id": self.owner_id},
+            "scope": self.scope or "collaboration",
+            "created_at": self.created_at,
+            "dataframes": [d.to_dict() for d in self.dataframes()],
+        }
+
+
+class SessionDataframe(Model):
+    """Bookkeeping for one named dataframe in a session: which task last
+    (re)built it, whether every node has materialized it, and its column
+    metadata — the content itself lives only in the nodes' session stores."""
+
+    TABLE = "session_dataframe"
+    COLUMNS = {
+        "session_id": "int",
+        "handle": "str",
+        "last_task_id": "int",
+        "ready": "bool",
+        "columns": "json",  # [{name, dtype}] as reported by nodes
+    }
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "session": {"id": self.session_id},
+            "handle": self.handle,
+            "last_task": (
+                {"id": self.last_task_id} if self.last_task_id else None
+            ),
+            "ready": bool(self.ready),
+            "columns": self.columns or [],
+        }
 
 
 class Port(Model):
@@ -413,6 +477,8 @@ ALL_MODELS: list[type[Model]] = [
     Task,
     TaskRun,
     Port,
+    Session,
+    SessionDataframe,
 ]
 ALL_LINKS = [collaboration_member, study_member, user_role, role_rule, user_rule]
 

@@ -50,10 +50,13 @@ RESOURCE_OPERATIONS: dict[str, list[Operation]] = {
     "rule": [Operation.VIEW],
     "event": [Operation.SEND, Operation.RECEIVE],
     "port": [Operation.VIEW],
+    "session": [
+        Operation.VIEW, Operation.CREATE, Operation.EDIT, Operation.DELETE
+    ],
 }
 
 # scopes that make sense per resource: OWN only where a row has an owner
-_OWNED = {"user", "task", "run"}
+_OWNED = {"user", "task", "run", "session"}
 
 
 def applicable_scopes(resource: str) -> list[Scope]:
@@ -130,6 +133,9 @@ class PermissionManager:
             self.rule("collaboration", Scope.ORGANIZATION, Operation.VIEW),
             self.rule("node", Scope.COLLABORATION, Operation.VIEW),
             self.rule("event", Scope.COLLABORATION, Operation.RECEIVE),
+            self.rule("session", Scope.COLLABORATION, Operation.VIEW),
+            self.rule("session", Scope.COLLABORATION, Operation.CREATE),
+            self.rule("session", Scope.OWN, Operation.DELETE),
         ]
         role("Researcher", "create and view tasks", researcher)
         viewer = [

@@ -595,6 +595,116 @@ def register_resources(srv: "ServerApp") -> None:
         study.delete()
         return {}, 204
 
+    # ------------------------------------------------------------- sessions
+    def _session_visible(user: m.User, s: m.Session) -> bool:
+        if (s.scope or "collaboration") == "own" and s.owner_id != user.id:
+            return False
+        return pm.allowed(
+            user, "session", Operation.VIEW,
+            collaboration_id=s.collaboration_id, owner_id=s.owner_id,
+        )
+
+    @app.route("/api/session", methods=("GET", "POST"))
+    def sessions(req: Request):
+        user = _require_user(srv, req)
+        if req.method == "GET":
+            rows = [
+                s for s in m.Session.list() if _session_visible(user, s)
+            ]
+            return _paginate(req, rows)
+        body = sch.load(sch.SessionInput(), req.json)
+        collab = _get_or_404(m.Collaboration, body["collaboration_id"])
+        _check(
+            pm.allowed(
+                user, "session", Operation.CREATE,
+                collaboration_id=collab.id,
+            )
+        )
+        if body["study_id"] is not None:
+            study = _get_or_404(m.Study, body["study_id"])
+            if study.collaboration_id != collab.id:
+                raise HTTPError(400, "study not in collaboration")
+        session = m.Session(
+            name=body["name"],
+            collaboration_id=collab.id,
+            study_id=body["study_id"],
+            owner_id=user.id,
+            scope=body["scope"],
+        ).save()
+        return session.to_dict(), 201
+
+    @app.route("/api/session/<int:id>", methods=("GET", "DELETE"))
+    def session_one(req: Request, id: int):
+        kind, principal = _identity(srv, req)
+        session = _get_or_404(m.Session, id)
+        if req.method == "GET":
+            if kind == "node":
+                # nodes probe session existence to reconcile their local
+                # stores after downtime (a 404 means: drop the store)
+                _check(
+                    principal.collaboration_id == session.collaboration_id
+                )
+                return session.to_dict()
+            _check(kind == "user")
+            _check(_session_visible(principal, session))
+            return session.to_dict()
+        user = _require_user(srv, req)
+        _check(
+            pm.allowed(
+                user, "session", Operation.DELETE,
+                collaboration_id=session.collaboration_id,
+                owner_id=session.owner_id,
+            )
+        )
+        for df in session.dataframes():
+            df.delete()
+        session.delete()
+        # nodes drop their local stores on this event
+        srv.hub.emit(
+            ev.SESSION_DELETED,
+            {"session_id": id},
+            room=ev.collaboration_room(session.collaboration_id),
+        )
+        return {}, 204
+
+    @app.route("/api/session/<int:id>/dataframe", methods=("GET",))
+    def session_dataframes(req: Request, id: int):
+        user = _require_user(srv, req)
+        session = _get_or_404(m.Session, id)
+        _check(_session_visible(user, session))
+        return _paginate(req, session.dataframes())
+
+    @app.route("/api/session/<int:id>/dataframe/<handle>", methods=("PATCH",))
+    def session_dataframe_patch(req: Request, id: int, handle: str):
+        """Nodes report materialization: ready flag + column metadata.
+        Content never crosses this endpoint — bookkeeping only."""
+        kind, principal = _identity(srv, req)
+        session = _get_or_404(m.Session, id)
+        df = m.SessionDataframe.first(session_id=id, handle=handle)
+        if df is None:
+            raise HTTPError(404, f"session has no dataframe {handle!r}")
+        if kind == "node":
+            if principal.collaboration_id != session.collaboration_id:
+                raise HTTPError(403, "node outside session collaboration")
+        else:
+            raise HTTPError(403, "only nodes report dataframe state")
+        body = sch.load(sch.SessionDataframePatch(), req.json)
+        if body["ready"]:
+            # ready means "EVERY node has materialized it": each node
+            # reports after completing its extraction run, so recompute
+            # from the task's run statuses — the LAST reporter flips it
+            task = m.Task.get(df.last_task_id) if df.last_task_id else None
+            runs = task.runs() if task else []
+            df.ready = bool(runs) and all(
+                r.status == TaskStatus.COMPLETED.value for r in runs
+            )
+        elif body["ready"] is not None:
+            df.ready = False
+        if body["columns"] is not None:
+            df.columns = body["columns"]
+        df.save()
+        return df.to_dict()
+
     # ---------------------------------------------------------------- nodes
     @app.route("/api/node", methods=("GET", "POST"))
     def nodes(req: Request):
@@ -1129,6 +1239,41 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
                 400, f"organization {spec['id']} not in collaboration/study"
             )
 
+    # sessions: validate the workspace and any dataframe references; the
+    # server only bookkeeps handles — content stays at the nodes
+    session_id = body["session_id"]
+    session = None
+    if session_id is not None:
+        session = m.Session.get(session_id)
+        if session is None or session.collaboration_id != collab.id:
+            raise HTTPError(400, "session not in collaboration")
+        if kind == "user" and (session.scope or "collaboration") == "own" \
+                and session.owner_id != principal.id:
+            raise HTTPError(403, "session is private to its owner")
+    handles = {d.handle for d in session.dataframes()} if session else set()
+    for db in body["databases"] or []:
+        if db.get("type") == "session":
+            if session is None:
+                raise HTTPError(
+                    400, "session dataframe reference without session_id"
+                )
+            if not db.get("dataframe"):
+                raise HTTPError(
+                    400, 'session database entries need a "dataframe" handle'
+                )
+            if db["dataframe"] not in handles:
+                raise HTTPError(
+                    400,
+                    f"session has no dataframe {db['dataframe']!r} "
+                    f"(known: {sorted(handles)})",
+                )
+    store_as = body["store_as"]
+    if store_as is not None:
+        if session is None:
+            raise HTTPError(400, "store_as requires a session_id")
+        if not store_as.replace("_", "").replace("-", "").isalnum():
+            raise HTTPError(400, "store_as must be a simple identifier")
+
     task = m.Task(
         name=body["name"],
         description=body["description"],
@@ -1140,7 +1285,20 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
         init_org_id=init_org_id,
         init_user_id=init_user_id,
         databases=body["databases"] or [{"label": "default"}],
+        session_id=session_id,
+        store_as=store_as,
     ).save()
+    if store_as is not None:
+        df = m.SessionDataframe.first(
+            session_id=session_id, handle=store_as
+        )
+        if df is None:
+            df = m.SessionDataframe(
+                session_id=session_id, handle=store_as
+            )
+        df.last_task_id = task.id
+        df.ready = False
+        df.save()
     if job_id is None:
         job_id = task.id  # a root task starts its own job group
     task.job_id = job_id

@@ -110,6 +110,24 @@ class NodeInput(_Base):
 class DatabaseSpec(_Base):
     label = fields.Str(required=True)
     type = fields.Str(load_default=None)
+    # sessions: type="session" reads the named dataframe from the node's
+    # session store instead of a source database
+    dataframe = fields.Str(load_default=None)
+
+
+class SessionInput(_Base):
+    name = fields.Str(required=True, validate=validate.Length(min=1))
+    collaboration_id = fields.Int(required=True)
+    study_id = fields.Int(load_default=None)
+    scope = fields.Str(
+        load_default="collaboration",
+        validate=validate.OneOf(["own", "collaboration"]),
+    )
+
+
+class SessionDataframePatch(_Base):
+    ready = fields.Bool(load_default=None)
+    columns = fields.List(fields.Dict(keys=fields.Str()), load_default=None)
 
 
 class TaskInput(_Base):
@@ -126,6 +144,9 @@ class TaskInput(_Base):
         validate=validate.Length(min=1),
     )
     databases = fields.List(fields.Nested(DatabaseSpec), load_default=list)
+    # sessions
+    session_id = fields.Int(load_default=None)
+    store_as = fields.Str(load_default=None)
 
 
 class RunPatch(_Base):
