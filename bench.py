@@ -319,9 +319,11 @@ def worker_transformer() -> None:
         seq, batch, vocab = TF_CPU["seq"], TF_CPU["batch"], TF_CPU["vocab"]
     # Flash (compiled Pallas) is OPT-IN on this runtime: executing any
     # compiled pallas_call over the axon TPU tunnel wedges the tunnel
-    # machine-wide (documented in .claude/skills/verify/SKILL.md), so the
-    # default path is the XLA ring attention; set BENCH_FLASH=1 on real
-    # (non-tunneled) TPU hardware to bench the kernel.
+    # machine-wide (documented in .claude/skills/verify/SKILL.md). The
+    # default TPU path is therefore `recompute` — flash-MEMORY attention in
+    # plain XLA (blockwise forward + recompute backward, no [T, T]
+    # residuals) — with BENCH_FLASH=1 enabling the kernel on real
+    # (non-tunneled) TPU hardware.
     want_flash = on_tpu and os.environ.get("BENCH_FLASH", "0") == "1"
 
     def build(attention: str):
@@ -343,7 +345,9 @@ def worker_transformer() -> None:
         compile_s = time.perf_counter() - t0
         return eng, params, opt, tokens, mask, compile_s
 
-    attention = "flash" if want_flash else "ring"
+    attention = "flash" if want_flash else (
+        "recompute" if on_tpu else "ring"
+    )
     attn_outcome = attention
     try:
         eng, params, opt, tokens, mask, compile_s = build(attention)
@@ -351,9 +355,9 @@ def worker_transformer() -> None:
         if attention != "flash":
             raise
         attn_outcome = (
-            f"flash failed -> ring: {type(e).__name__}: {str(e)[:200]}"
+            f"flash failed -> recompute: {type(e).__name__}: {str(e)[:200]}"
         )
-        eng, params, opt, tokens, mask, compile_s = build("ring")
+        eng, params, opt, tokens, mask, compile_s = build("recompute")
 
     # several chained steps per timed run: the per-run host pull costs a
     # tunnel round-trip, which would inflate a single ~100ms step by ~10%

@@ -78,11 +78,15 @@ class _TaskSubClient:
         organizations: list[int],
         name: str = "subtask",
         databases: list[dict[str, Any]] | None = None,
+        session: int | None = None,
+        store_as: str | None = None,
         **_compat: Any,
     ) -> dict[str, Any]:
         """Create a subtask on the given organization ids.
 
         Returns the task as a dict (reference wire shape, incl. ``id``).
+        Subtasks inherit the parent's session when none is given, so a
+        central function's fan-out reads/writes the same workspace.
         """
         parent = self._p._task
         image = parent.image if parent else self._p._image
@@ -91,6 +95,8 @@ class _TaskSubClient:
                 "no algorithm image in scope — construct AlgorithmClient "
                 "with image=... for top-level use"
             )
+        if session is None and parent is not None:
+            session = parent.session_id
         task = self._p._fed.create_task(
             image=image,
             input_=input_,
@@ -98,6 +104,8 @@ class _TaskSubClient:
             name=name,
             databases=databases,
             parent=parent,
+            session=session,
+            store_as=store_as,
         )
         return task.to_dict()
 

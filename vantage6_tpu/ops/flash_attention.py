@@ -121,16 +121,14 @@ def flash_attention(
     return fn(q, k, v, qoff, koff)
 
 
-@functools.lru_cache(maxsize=None)
-def _flash_vjp(causal, scale, block_q, block_k, interpret):
-    """custom_vjp wrapper per static config (cached so jax sees ONE callable
-    per config — fresh wrappers would defeat jit tracing caches)."""
+def _attach_recompute_vjp(forward, causal, scale):
+    """Wrap `forward(q, k, v, qoff, koff) -> o` in a custom_vjp whose
+    backward is the blockwise recompute (_attention_bwd): residuals are
+    only (q, k, v, o) — never the [Tq, Tk] score/probability tensors."""
 
     @jax.custom_vjp
     def fa(q, k, v, qoff, koff):
-        return _flash_forward(
-            q, k, v, qoff, koff, causal, scale, block_q, block_k, interpret
-        )
+        return forward(q, k, v, qoff, koff)
 
     def fwd(q, k, v, qoff, koff):
         o = fa(q, k, v, qoff, koff)
@@ -145,6 +143,111 @@ def _flash_vjp(causal, scale, block_q, block_k, interpret):
 
     fa.defvjp(fwd, bwd)
     return fa
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_vjp(causal, scale, block_q, block_k, interpret):
+    """custom_vjp wrapper per static config (cached so jax sees ONE callable
+    per config — fresh wrappers would defeat jit tracing caches)."""
+    return _attach_recompute_vjp(
+        functools.partial(
+            _flash_forward, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, interpret=interpret,
+        ),
+        causal,
+        scale,
+    )
+
+
+def recompute_attention(
+    q: jax.Array,  # [B, H, Tq, D]
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    causal: bool = False,
+    scale: float | None = None,
+    block_k: int = 128,
+) -> jax.Array:
+    """Flash-MEMORY attention without a Pallas kernel: a blockwise
+    (lax.scan over key blocks) online-softmax forward in plain jnp/XLA plus
+    the same blockwise custom_vjp backward as the kernel path.
+
+    Peak transient memory is O(Tq * block_k) in BOTH directions and the
+    residuals are just (q, k, v, o) — the [Tq, Tk] probabilities that a
+    naive XLA attention saves for backward (the memory wall for long
+    context) never exist. Use this where the Pallas kernel is unavailable
+    (e.g. the axon tunnel, which a compiled pallas_call wedges — see
+    .claude/skills/verify/SKILL.md); the kernel remains the faster option
+    on directly attached TPUs."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    fn = _recompute_vjp(causal, float(scale), block_k)
+    return fn(
+        q, k, v,
+        jnp.asarray(q_offset, jnp.int32),
+        jnp.asarray(k_offset, jnp.int32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _recompute_vjp(causal, scale, block_k):
+    return _attach_recompute_vjp(
+        functools.partial(
+            _blockwise_forward, causal=causal, scale=scale, block_k=block_k
+        ),
+        causal,
+        scale,
+    )
+
+
+def _blockwise_forward(q, k, v, q_offset, k_offset, *, causal, scale,
+                       block_k):
+    """Online-softmax forward over key blocks (jnp; mirrors the kernel)."""
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    block = min(block_k, t_k)
+    pad_k = (-t_k) % block
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    n_blocks = (t_k + pad_k) // block
+    kb = jnp.moveaxis(k.reshape(b, h, n_blocks, block, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, n_blocks, block, d), 2, 0)
+    base = jnp.arange(n_blocks) * block
+    q_pos = jnp.reshape(q_offset, ()) + jnp.arange(t_q)
+    k_off = jnp.reshape(k_offset, ())
+
+    def step(carry, blk):
+        m, l, acc = carry
+        k_j, v_j, idx0 = blk
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k_j, preferred_element_type=jnp.float32
+        ) * scale
+        k_idx = idx0 + jnp.arange(block)
+        valid = (k_idx < t_k)[None, :]
+        if causal:
+            valid = valid & (q_pos[:, None] >= (k_off + k_idx)[None, :])
+        s = jnp.where(valid[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.maximum(jnp.max(s, -1), -1e20))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + jnp.sum(p, -1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_j.dtype), v_j,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
+    (m, l, acc), _ = lax.scan(
+        step,
+        (m0, jnp.zeros_like(m0), jnp.zeros((b, h, t_q, d), jnp.float32)),
+        (kb, vb, base),
+    )
+    denom = jnp.where(l > 0, l, 1.0)[..., None]
+    return (acc / denom).astype(q.dtype)
 
 
 def _flash_forward(

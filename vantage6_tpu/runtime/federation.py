@@ -82,6 +82,14 @@ class Federation:
         # station data: per-station {label: dataset}; device-mode stacked
         # arrays cached per label.
         self._data: list[dict[str, Any]] = [{} for _ in self.stations]
+        # sessions (reference v4.7): per-station in-memory dataframe stores,
+        # keyed session id -> {handle: DataFrame} — the simulator analogue
+        # of each node's local pickle store
+        self._sessions: dict[int, dict[str, Any]] = {}
+        self._session_stores: list[dict[int, dict[str, Any]]] = [
+            {} for _ in self.stations
+        ]
+        self._session_ids = iter(range(1, 10**9))
         self._stacked_cache: dict[str, Any] = {}
         self._algorithms: dict[str, dict[str, Callable]] = {}
         for image, mod in (algorithms or {}).items():
@@ -173,6 +181,23 @@ class Federation:
         return jnp.asarray(self._online, jnp.float32)
 
     # ----------------------------------------------------------------- tasks
+    # --------------------------------------------------------------- sessions
+    def create_session(self, name: str = "session") -> int:
+        """A workspace whose named dataframes persist at each station
+        between tasks (reference v4.7 'sessions'); returns its id."""
+        sid = next(self._session_ids)
+        self._sessions[sid] = {"name": name, "dataframes": {}}
+        return sid
+
+    def session_dataframes(self, session_id: int) -> dict[str, Any]:
+        """Bookkeeping: handle -> {ready, columns} (content stays local)."""
+        return dict(self._sessions[session_id]["dataframes"])
+
+    def delete_session(self, session_id: int) -> None:
+        self._sessions.pop(session_id, None)
+        for store in self._session_stores:
+            store.pop(session_id, None)
+
     def create_task(
         self,
         image: str,
@@ -182,6 +207,8 @@ class Federation:
         databases: list[dict[str, Any]] | None = None,
         parent: Task | None = None,
         init_user: str = "",
+        session: int | None = None,
+        store_as: str | None = None,
     ) -> Task:
         """Create + dispatch a task (reference: POST /api/task + fan-out).
 
@@ -193,6 +220,22 @@ class Federation:
         method = input_.get("method")
         if not method:
             raise ValueError('input_ needs a "method"')
+        if session is not None and session not in self._sessions:
+            raise ValueError(f"unknown session {session}")
+        if store_as is not None and session is None:
+            raise ValueError("store_as requires a session")
+        for d in databases or []:
+            if d.get("type") == "session":
+                if session is None:
+                    raise ValueError(
+                        "session dataframe reference without a session"
+                    )
+                handle = d.get("dataframe") or d.get("label")
+                if handle not in self._sessions[session]["dataframes"]:
+                    raise ValueError(
+                        f"session has no dataframe {handle!r} (known: "
+                        f"{sorted(self._sessions[session]['dataframes'])})"
+                    )
         if parent and not init_user:
             # Subtasks act on behalf of the user who created the parent, so
             # allowed_users policies apply to the whole task tree.
@@ -215,7 +258,14 @@ class Federation:
             parent_id=parent.id if parent else None,
             collaboration=self.config.name,
             init_user=init_user,
+            session_id=session,
+            store_as=store_as,
         )
+        if store_as is not None:
+            self._sessions[session]["dataframes"][store_as] = {
+                "ready": False,
+                "columns": [],
+            }
         task.runs = [
             new_run(
                 task_id=task.id,
@@ -322,13 +372,62 @@ class Federation:
             for i in range(self.n_stations)
         }
 
+    def _resolve_frame(self, task: Task, station: int, d: dict[str, Any]):
+        if d.get("type") == "session":
+            handle = d.get("dataframe") or d.get("label")
+            store = self._session_stores[station].get(task.session_id, {})
+            if handle not in store:
+                raise KeyError(
+                    f"session {task.session_id} has no materialized "
+                    f"dataframe {handle!r} at station {station} (did its "
+                    "extraction task run?)"
+                )
+            return store[handle]
+        return self.station_data(station, d.get("label", "default"))
+
+    def _store_session_result(self, task: Task, run: Run, result: Any):
+        """Persist a store_as run's dataframe at ITS station; the run's
+        recorded result is metadata only (same contract as node.runner)."""
+        import pandas as pd
+
+        df = result
+        if isinstance(df, dict) and "dataframe" in df:
+            df = df["dataframe"]
+        if not isinstance(df, pd.DataFrame):
+            raise RuntimeError(
+                f"task stores dataframe {task.store_as!r} but the algorithm"
+                f" returned {type(result).__name__}, not a DataFrame"
+            )
+        self._session_stores[run.station_index].setdefault(
+            task.session_id, {}
+        )[task.store_as] = df
+        meta = {
+            "stored": task.store_as,
+            "session_id": task.session_id,
+            "rows": int(len(df)),
+            "columns": [
+                {"name": str(c), "dtype": str(t)}
+                for c, t in df.dtypes.items()
+            ],
+        }
+        # ready only when EVERY station's run completed (this run's finish
+        # is recorded by the caller right after, so count it as done)
+        others_done = all(
+            r.status == TaskStatus.COMPLETED or r.id == run.id
+            for r in task.runs
+        )
+        book = self._sessions[task.session_id]["dataframes"][task.store_as]
+        book["columns"] = meta["columns"]
+        book["ready"] = others_done
+        return meta
+
     # ------------------------------------------------------------- host mode
     def _run_host(self, task: Task, fn: Callable, run: Run) -> None:
         from vantage6_tpu.algorithm.client import AlgorithmClient
 
         run.start()
         frames = [
-            self.station_data(run.station_index, d.get("label", "default"))
+            self._resolve_frame(task, run.station_index, d)
             for d in task.databases
         ]
         env = AlgorithmEnvironment(
@@ -351,7 +450,10 @@ class Federation:
         kwargs = task.input_.get("kwargs", {}) or {}
         try:
             with algorithm_environment(env):
-                run.finish(fn(*args, **kwargs))
+                result = fn(*args, **kwargs)
+            if task.store_as:
+                result = self._store_session_result(task, run, result)
+            run.finish(result)
         except Exception:
             run.crash(traceback.format_exc(limit=8))
 

@@ -70,6 +70,10 @@ class Task:
     init_org: str = ""
     init_user: str = ""
     collaboration: str = ""
+    # sessions: the workspace this task runs in + the handle its returned
+    # dataframe is persisted under at each station
+    session_id: int | None = None
+    store_as: str | None = None
     runs: list[Run] = dataclasses.field(default_factory=list)
     created_at: float = dataclasses.field(default_factory=time.time)
     # Device-mode only: the stacked [S, ...] on-device result pytree (full

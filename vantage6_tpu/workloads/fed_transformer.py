@@ -27,7 +27,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from vantage6_tpu.core.mesh import _NO_VMA_KW, STATION_AXIS, shard_map
 from vantage6_tpu.fed import collectives
-from vantage6_tpu.ops.flash_attention import flash_attention
+from vantage6_tpu.ops.flash_attention import (
+    flash_attention,
+    recompute_attention,
+)
 from vantage6_tpu.parallel.ring_attention import ring_attention
 
 SEQ_AXIS = "device"  # sequence parallelism rides the within-station axis
@@ -48,6 +51,10 @@ class TransformerConfig:
     # "flash": the Pallas flash kernel (ops.flash_attention) — requires the
     # full sequence on each device (seq_devices == 1, enforced by
     # make_engine); `flash_interpret` runs it in interpret mode on CPU.
+    # "recompute": flash-memory attention WITHOUT pallas (blockwise jnp
+    # forward + recompute backward; ops.recompute_attention) — same
+    # seq_devices == 1 constraint; the long-context choice on runtimes
+    # where compiled pallas is unavailable.
     attention: str = "ring"
     flash_interpret: bool = False
 
@@ -112,18 +119,26 @@ def forward_local(
         q = q.reshape(b, t_local, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, t_local, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, t_local, cfg.n_heads, cfg.head_dim)
-        if cfg.attention == "flash":
-            # Pallas kernel wants head-major [B, H, T, D]; offsets keep the
-            # causal mask correct for any sequence shard (here the full
-            # sequence — make_engine enforces seq_devices == 1 for flash)
-            attn = flash_attention(
+        if cfg.attention in ("flash", "recompute"):
+            # both want head-major [B, H, T, D]; offsets keep the causal
+            # mask correct for any sequence shard (here the full sequence —
+            # make_engine enforces seq_devices == 1 for these modes)
+            impl = (
+                flash_attention if cfg.attention == "flash"
+                else recompute_attention
+            )
+            kw = (
+                {"interpret": cfg.flash_interpret}
+                if cfg.attention == "flash" else {}
+            )
+            attn = impl(
                 q.transpose(0, 2, 1, 3),
                 k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3),
                 q_offset=offset,
                 k_offset=offset,
                 causal=True,
-                interpret=cfg.flash_interpret,
+                **kw,
             ).transpose(0, 2, 1, 3)
         else:
             attn = ring_attention(q, k, v, axis_name, causal=True)
@@ -234,10 +249,10 @@ def make_engine(
     devices: Any = None,
 ) -> FedTransformer:
     cfg = cfg or TransformerConfig()
-    if cfg.attention == "flash" and seq_devices != 1:
+    if cfg.attention in ("flash", "recompute") and seq_devices != 1:
         raise ValueError(
-            "attention='flash' needs the full sequence per device "
-            f"(seq_devices == 1, got {seq_devices}); use 'ring' for "
+            f"attention={cfg.attention!r} needs the full sequence per "
+            f"device (seq_devices == 1, got {seq_devices}); use 'ring' for "
             "sequence-parallel runs"
         )
     devs = list(devices if devices is not None else jax.devices())
