@@ -57,6 +57,47 @@ def test_policy_glob_allows():
     assert task.status == TaskStatus.COMPLETED
 
 
+def test_imported_decorated_fn_not_dispatchable_from_real_module():
+    """A real (spec-carrying) algorithm module that imports a decorated
+    partial from another module must NOT expose it as a remotely callable
+    method — only dynamically assembled modules get the marker fallback."""
+    import sys
+    import textwrap
+    import types
+
+    src = textwrap.dedent(
+        """
+        from vantage6_tpu.algorithm import data
+
+        @data(1)
+        def own_method(df):
+            return {"n": len(df)}
+        """
+    )
+    import importlib.util
+
+    spec = importlib.util.spec_from_loader("v6t_real_algo_mod", loader=None)
+    real_mod = importlib.util.module_from_spec(spec)
+    sys.modules["v6t_real_algo_mod"] = real_mod
+    try:
+        exec(src, real_mod.__dict__)
+        real_mod.count_rows = count_rows  # imported decorated helper
+        fed = two_station_fed()
+        fed.register_algorithm("real-image", real_mod)
+        assert fed.resolve_function("real-image", "own_method") is not None
+        assert fed.resolve_function("real-image", "count_rows") is None
+    finally:
+        del sys.modules["v6t_real_algo_mod"]
+
+    # ...while a dynamically assembled module (no __spec__) dispatches its
+    # attached decorated functions even though __module__ differs
+    dyn = types.ModuleType("v6t_dyn_algo_mod")
+    dyn.count_rows = count_rows
+    fed2 = two_station_fed()
+    fed2.register_algorithm("dyn-image", dyn)
+    assert fed2.resolve_function("dyn-image", "count_rows") is not None
+
+
 def test_no_image():
     fed = two_station_fed()
     task = fed.create_task("ghost-image", {"method": "count_rows"})

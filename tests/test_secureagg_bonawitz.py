@@ -135,6 +135,29 @@ class TestRecovery:
             bon.recover_sum(uploads, pubs, reveals, tag, threshold=t,
                             scale=scale)
 
+    def test_minority_threshold_rejected_everywhere(self):
+        """t <= n/2 would let a lying aggregator show disjoint survivor
+        lists to two >= t groups and collect BOTH share types for one
+        uploaded station; every entry point must refuse such a threshold."""
+        n, tag = 4, "t"
+        secrets_, pubs = _setup(n, tag)
+        for bad_t in (0, 1, n // 2):
+            with pytest.raises(ValueError, match="threshold"):
+                bon.make_recovery_shares(
+                    secrets_[0], 0, pubs, tag, threshold=bad_t
+                )
+            with pytest.raises(ValueError, match="threshold"):
+                bon.reveal_for_recovery(
+                    secrets_[0], 0, pubs, {}, survivors=[0, 1, 2, 3],
+                    tag=tag, threshold=bad_t,
+                )
+            with pytest.raises(ValueError, match="threshold"):
+                bon.recover_sum({}, pubs, {}, tag, threshold=bad_t)
+        with pytest.raises(ValueError, match="threshold"):
+            bon.make_recovery_shares(
+                secrets_[0], 0, pubs, tag, threshold=n + 1
+            )
+
     def test_honest_station_refuses_to_reveal_for_itself_when_dropped(self):
         n, tag = 3, "t"
         secrets_, pubs = _setup(n, tag)

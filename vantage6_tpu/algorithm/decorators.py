@@ -119,3 +119,13 @@ def _copy_markers(src: Callable, dst: Callable) -> None:
     for m in _MARKERS:
         if getattr(src, m, None):
             setattr(dst, m, getattr(src, m))
+
+
+def is_v6t_function(fn: Any) -> bool:
+    """True if ``fn`` was wrapped by one of this module's decorators.
+
+    Used by algorithm registration to recognise dispatchable functions even
+    when they were attached to a dynamically assembled module (their
+    ``__module__`` then names the defining file, not the module object).
+    """
+    return callable(fn) and any(getattr(fn, m, None) for m in _MARKERS)

@@ -62,6 +62,20 @@ def default_threshold(n: int) -> int:
     return n // 2 + 1
 
 
+def _check_threshold(n: int, t: int) -> int:
+    """The 'never reveal both' invariant only holds for t > n/2: two
+    disjoint groups of >= t stations cannot then exist, so a lying
+    aggregator cannot collect t self-mask shares from one group AND t
+    key-seed shares from another for the same uploaded station. Reject any
+    weaker threshold at every share/reveal/recover entry point."""
+    if not n // 2 < t <= n:
+        raise ValueError(
+            f"threshold {t} violates n//2 < t <= n (n={n}): a minority "
+            "threshold lets a lying aggregator unmask an honest upload"
+        )
+    return t
+
+
 def selfmask_seed(station_secret: bytes, tag) -> bytes:
     if len(station_secret) < 16:
         raise ValueError("station secret must be >= 16 bytes")
@@ -126,7 +140,9 @@ def make_recovery_shares(
     """
     pubs = dict(pubkeys)
     n = len(pubs)
-    t = threshold or default_threshold(n)
+    t = _check_threshold(
+        n, default_threshold(n) if threshold is None else threshold
+    )
     priv, _ = derive_keypair(station_secret, tag)
     ikm = keypair_ikm(station_secret, tag)
     b_seed = selfmask_seed(station_secret, tag)
@@ -215,7 +231,9 @@ def reveal_for_recovery(
     # survivor revealing its own b-share is safe — b_me is *meant* to be
     # stripped from the total once my upload is in.
     n = len(pubs)
-    t = threshold or default_threshold(n)
+    t = _check_threshold(
+        n, default_threshold(n) if threshold is None else threshold
+    )
     order = sorted(pubs)
     my_rank = order.index(station)
     coeff_len = (t - 1) * 32
@@ -245,7 +263,9 @@ def recover_sum(
     """
     pubs = dict(pubkeys)
     n = len(pubs)
-    t = threshold or default_threshold(n)
+    t = _check_threshold(
+        n, default_threshold(n) if threshold is None else threshold
+    )
     order = sorted(pubs)
     rank = {s: r for r, s in enumerate(order)}
     survivors = sorted(uploads)

@@ -283,8 +283,11 @@ def _flash_forward(
     )
     # under shard_map with VMA checking, pallas_call outputs must declare
     # which mesh axes they vary over — the output varies exactly as q does
-    # (frozenset() outside shard_map, i.e. no-op there)
-    vma = getattr(jax.typeof(q), "vma", None)
+    # (frozenset() outside shard_map, i.e. no-op there). jax.typeof and the
+    # vma= kwarg are recent-JAX APIs; on older installs neither exists, so
+    # build the kwargs conditionally instead of crashing outside shard_map.
+    vma = getattr(jax.typeof(q), "vma", None) if hasattr(jax, "typeof") else None
+    shape_kwargs = {"vma": vma} if vma is not None else {}
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -299,7 +302,8 @@ def _flash_forward(
                 (None, block_q, d), lambda bh, i, *_: (bh, i, 0)
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype, vma=vma),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype,
+                                       **shape_kwargs),
         interpret=interpret,
     )(qoff, koff, kvalid, qh, kh, vh)
     out = out.reshape(b, h, tq_p, d)

@@ -36,6 +36,7 @@ from vantage6_tpu.algorithm.context import (
     algorithm_environment,
 )
 from vantage6_tpu.algorithm.data_loading import load_data
+from vantage6_tpu.algorithm.decorators import is_v6t_function
 from vantage6_tpu.common.enums import TaskStatus
 from vantage6_tpu.core.config import DatabaseConfig, FederationConfig
 from vantage6_tpu.core.mesh import FederationMesh, Station
@@ -143,12 +144,22 @@ class Federation:
         else:
             # Only functions DEFINED in the module are dispatchable — imported
             # helpers (decorators, jnp, ...) must not become callable methods.
+            # Exception: a dynamically assembled module (types.ModuleType, no
+            # __spec__) can't satisfy the __module__ check — functools.wraps
+            # keeps the defining file's name — so there, and only there,
+            # v6t-decorated functions are dispatchable too. Real imported
+            # modules keep the strict filter: an imported decorated partial
+            # must not become remotely callable under this image's name.
+            dynamic = getattr(module, "__spec__", None) is None
             fns = {
                 name: fn
                 for name, fn in vars(module).items()
                 if callable(fn)
                 and not name.startswith("_")
-                and getattr(fn, "__module__", None) == module.__name__
+                and (
+                    getattr(fn, "__module__", None) == module.__name__
+                    or (dynamic and is_v6t_function(fn))
+                )
             }
         self._algorithms[image] = fns
 
@@ -426,10 +437,14 @@ class Federation:
         from vantage6_tpu.algorithm.client import AlgorithmClient
 
         run.start()
-        frames = [
-            self._resolve_frame(task, run.station_index, d)
-            for d in task.databases
-        ]
+        try:
+            frames = [
+                self._resolve_frame(task, run.station_index, d)
+                for d in task.databases
+            ]
+        except Exception:
+            run.crash(traceback.format_exc(limit=8))
+            return
         env = AlgorithmEnvironment(
             dataframes=frames,
             client=AlgorithmClient(self, task=task, station=run.station_index),
