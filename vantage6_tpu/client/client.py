@@ -206,10 +206,16 @@ class TaskSubClient(SubClient):
         study: int | None = None,
         session: int | None = None,
         store_as: str | None = None,
+        engine: str | None = None,
     ) -> dict[str, Any]:
         """Create a task; `input_` is the reference wire shape
         ``{"method", "args", "kwargs"}``, serialized then encrypted per
-        destination organization's public key when E2E crypto is on."""
+        destination organization's public key when E2E crypto is on.
+
+        ``engine="device"`` submits a device-engine task: every targeted
+        node executes the SAME run as one collective SPMD program over the
+        federation's global device mesh (the nodes must be configured with
+        ``device_engine`` so their daemons joined the mesh at start)."""
         input_ = input_ or {}
         blob = serialize(input_)
         org_specs = []
@@ -256,6 +262,8 @@ class TaskSubClient(SubClient):
             body["session_id"] = session
         if store_as is not None:
             body["store_as"] = store_as
+        if engine is not None:
+            body["engine"] = engine
         return self.parent.request("POST", "task", body)
 
     def kill(self, task_id: int) -> dict[str, Any]:

@@ -9,6 +9,7 @@ same payloads — the cursor IS the reconnect path in both designs).
 """
 from __future__ import annotations
 
+import queue
 import threading
 import time
 import traceback
@@ -48,7 +49,27 @@ class NodeDaemon:
         max_concurrent_runs: int = 4,
         station_secret: str | bytes | None = None,
         vpn: dict[str, Any] | None = None,
+        device_engine: dict[str, Any] | None = None,
     ):
+        # Device-engine membership FIRST: jax.distributed must be joined
+        # before anything initializes the jax backend. With a coordinator
+        # configured this daemon becomes one process of the federation's
+        # global device mesh (DCN scale-out, core.distributed); an empty
+        # dict enables the engine on the local devices only. This is how
+        # the control plane meets the TPU data plane: a server-submitted
+        # engine="device" task executes as ONE SPMD program spanning every
+        # member daemon's devices.
+        self.device_engine_cfg = device_engine
+        if device_engine is not None:
+            from vantage6_tpu.core import distributed as _dist
+
+            _dist.initialize(
+                coordinator_address=device_engine.get("coordinator"),
+                num_processes=device_engine.get("num_processes"),
+                process_id=device_engine.get("process_id"),
+                local_device_ids=device_engine.get("local_device_ids"),
+                auto=bool(device_engine.get("auto", False)),
+            )
         self.api_url = api_url.rstrip("/")
         self.api_key = api_key
         self.poll_interval = poll_interval
@@ -71,6 +92,15 @@ class NodeDaemon:
         )
         self._claimed: set[int] = set()
         self._claim_lock = threading.Lock()
+        # device-engine runs execute on a DEDICATED single worker in
+        # ascending task-id order: collective SPMD programs must enter in
+        # the same globally agreed order on every member daemon, or two
+        # concurrent device tasks grabbed in opposite orders deadlock the
+        # mesh (each member waiting inside a different program)
+        self._device_queue: "queue.PriorityQueue[tuple[int, int]]" = (
+            queue.PriorityQueue()
+        )
+        self._device_thread: threading.Thread | None = None
 
         # authenticate (reference: Node.__init__ authenticates first)
         data = self._post_raw(
@@ -105,6 +135,7 @@ class NodeDaemon:
             policies=policies,
             mode=mode,
             station_secret=station_secret,
+            device_engine=device_engine is not None,
         )
         # VPN parity (reference item 13): no WireGuard exists here — the
         # manager's surviving job is registering algorithm-declared ports as
@@ -139,6 +170,7 @@ class NodeDaemon:
             name=ctx.name,
             station_secret=cfg.get("station_secret") or None,
             vpn=cfg.get("vpn") or None,
+            device_engine=cfg.get("device_engine"),
             **overrides,
         )
 
@@ -189,6 +221,12 @@ class NodeDaemon:
         ]
         self._sync_missed_runs()
         self._reconcile_sessions()
+        if self.runner.device_engine:
+            self._device_thread = threading.Thread(
+                target=self._device_worker, daemon=True,
+                name="v6t-device-engine",
+            )
+            self._device_thread.start()
         if background:
             self._thread = threading.Thread(target=self._listen, daemon=True)
             self._thread.start()
@@ -200,6 +238,8 @@ class NodeDaemon:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._device_thread:
+            self._device_thread.join(timeout=10)
         self._pool.shutdown(wait=True, cancel_futures=True)
         try:
             self.request("PATCH", f"node/{self.id}", {"status": "offline"})
@@ -297,12 +337,172 @@ class NodeDaemon:
             self._claimed.add(run_id)
         self._pool.submit(self._execute_logged, run_id)
 
-    def _execute_logged(self, run_id: int) -> None:
+    def _execute_logged(self, run_id: int, dispatched: bool = False) -> None:
         try:
-            self._execute(run_id)
+            self._execute(run_id, dispatched=dispatched)
         except Exception:
             log.error("run %s worker crashed:\n%s", run_id,
                       traceback.format_exc(limit=8))
+
+    def _device_worker(self) -> None:
+        """Drain device-engine runs one at a time, lowest task id first.
+
+        The local PriorityQueue only orders runs already delivered to THIS
+        daemon; the globally agreed order every mesh member must follow is
+        the server-assigned task id. So before entering a popped run, ask
+        the server whether an EARLIER device run for this node is still
+        pending (its event may simply not have arrived yet) — if so, run
+        that one first and keep the popped run queued.
+        """
+        attempted: set[int] = set()
+        # a task's engine is immutable: resolve each task id once, not on
+        # every ordering scan (the scan runs per device-run dispatch)
+        engine_cache: dict[int, str] = {}
+        while not self._stop.is_set():
+            try:
+                task_id, run_id = self._device_queue.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            lower = self._lower_pending_device_run(
+                task_id, attempted, engine_cache
+            )
+            if lower is not None:
+                self._device_queue.put((task_id, run_id))
+                l_task_id, l_run_id = lower
+                with self._claim_lock:
+                    self._claimed.add(l_run_id)
+                attempted.add(l_run_id)
+                self._execute_logged(l_run_id, dispatched=True)
+                continue
+            attempted.add(run_id)
+            self._execute_logged(run_id, dispatched=True)
+
+    def _lower_pending_device_run(
+        self,
+        task_id: int,
+        attempted: set[int],
+        engine_cache: dict[int, str],
+    ) -> tuple[int, int] | None:
+        """The server's word on ordering: the lowest-task-id PENDING device
+        run assigned to this node that precedes ``task_id`` (excluding runs
+        this worker already attempted — a run that failed before reaching a
+        terminal status must not wedge the queue). Drains every page: the
+        decisive run hiding on page 2 of a deep backlog would re-open the
+        opposite-order deadlock this check exists to prevent."""
+        candidates: list[tuple[int, int]] = []
+        page = 1
+        while True:
+            try:
+                body = self.request(
+                    "GET",
+                    "run",
+                    params={
+                        "status": TaskStatus.PENDING.value,
+                        "per_page": 250,
+                        "page": page,
+                    },
+                )
+            except Exception:
+                return None  # can't consult the server: local order only
+            for run in body.get("data", []):
+                tid = (run.get("task") or {}).get("id")
+                if tid is None or tid >= task_id or run["id"] in attempted:
+                    continue
+                candidates.append((tid, run["id"]))
+            total = body.get("pagination", {}).get("total", 0)
+            if page * 250 >= total or not body.get("data"):
+                break
+            page += 1
+        for tid, rid in sorted(candidates):
+            engine = engine_cache.get(tid)
+            if engine is None:
+                try:
+                    engine = self.request(
+                        "GET", f"task/{tid}"
+                    ).get("engine") or "process"
+                except Exception:
+                    continue
+                engine_cache[tid] = engine
+            if engine == "device":
+                return (tid, rid)
+        return None
+
+    def _await_device_peers(self, task: dict[str, Any], run_id: int) -> None:
+        """Control-plane barrier before entering a collective SPMD program.
+
+        Entering the program while ANY member daemon will never arrive
+        (its run failed to decrypt, was killed, its node refused or is
+        offline) blocks this thread inside the collectives until the comm
+        backend's own timeout fires. This barrier waits until every peer
+        run is ACTIVE (its daemon patched ACTIVE immediately before its own
+        barrier) and aborts cleanly if a peer reaches a failed state or the
+        wait times out. Single-process meshes skip it: their programs span
+        no other daemon.
+        """
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        timeout = float(
+            (self.device_engine_cfg or {}).get("barrier_timeout", 120.0)
+        )
+        failed_states = {s.value for s in TaskStatus.failed_statuses()}
+        waiting_states = {
+            TaskStatus.PENDING.value,
+            TaskStatus.INITIALIZING.value,
+        }
+        deadline = time.monotonic() + timeout
+        while not self._stop.is_set():
+            runs = self._all_task_runs(task["id"])
+            peers = [r for r in runs if r["id"] != run_id]
+            if not peers:
+                # fail closed: on a multi-process mesh a device task always
+                # has peer runs; seeing none means the server hid them
+                # (scoped listing) — entering the collective alone would
+                # block in the comm backend
+                raise RuntimeError(
+                    "no peer runs visible for a multi-process device task "
+                    "— refusing to enter the collective program alone"
+                )
+            bad = [r for r in peers if r["status"] in failed_states]
+            if bad:
+                raise RuntimeError(
+                    "aborting before collective entry: peer run(s) "
+                    f"{[(r['id'], r['status']) for r in bad]} will never "
+                    "join the SPMD program"
+                )
+            if run_id in self._killed:
+                raise RuntimeError("run killed while awaiting peers")
+            if all(r["status"] not in waiting_states for r in peers):
+                return
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"device-engine barrier timed out after {timeout:.0f}s: "
+                    f"peer runs still "
+                    f"{[(r['id'], r['status']) for r in peers if r['status'] in waiting_states]}"
+                    " — not entering the collective program without them"
+                )
+            # status transitions are human-scale; N daemons hammering the
+            # server at sub-second cadence buys no freshness
+            self._stop.wait(1.0)
+        raise RuntimeError("daemon stopping; device run abandoned")
+
+    def _all_task_runs(self, task_id: int) -> list[dict[str, Any]]:
+        """EVERY run of a task (full page drain — a >250-org collaboration
+        must not hide still-pending peers behind page 1)."""
+        out: list[dict[str, Any]] = []
+        page = 1
+        while True:
+            body = self.request(
+                "GET",
+                f"task/{task_id}/run",
+                params={"per_page": 250, "page": page},
+            )
+            out.extend(body["data"])
+            total = body.get("pagination", {}).get("total", len(out))
+            if page * 250 >= total or not body["data"]:
+                return out
+            page += 1
 
     def _sync_missed_runs(self) -> None:
         """Reference: sync_task_queue_with_server — execute runs queued
@@ -354,7 +554,7 @@ class NodeDaemon:
                 log.warning("session %s reconcile probe failed: %s", sid, e)
 
     # --------------------------------------------------------------- execute
-    def _execute(self, run_id: int) -> None:
+    def _execute(self, run_id: int, dispatched: bool = False) -> None:
         try:
             run = self.request("GET", f"run/{run_id}")
         except Exception as e:
@@ -363,6 +563,16 @@ class NodeDaemon:
         if run["status"] != TaskStatus.PENDING.value or run_id in self._killed:
             return
         task = self.request("GET", f"task/{run['task']['id']}")
+        if (
+            task.get("engine") == "device"
+            and self.runner.device_engine
+            and not dispatched
+        ):
+            # re-route to the dedicated ordered device worker (see __init__);
+            # an UNconfigured node falls through so the runner records the
+            # PolicyViolation as NOT_ALLOWED
+            self._device_queue.put((task["id"], run_id))
+            return
 
         def patch(**kw: Any) -> None:
             try:
@@ -386,6 +596,30 @@ class NodeDaemon:
                 finished_at=time.time(),
             )
             return
+        if task.get("engine") == "device":
+            # every DETERMINISTIC refusal must happen BEFORE this daemon
+            # goes ACTIVE: peers' barriers read ACTIVE as "will enter the
+            # collective program", and a post-ACTIVE refusal would leave
+            # them blocked inside the collectives (see preflight_device)
+            try:
+                self.runner.preflight_device(
+                    task["image"],
+                    str(task.get("init_user", {}).get("id", "")),
+                )
+            except PolicyViolation as e:
+                patch(
+                    status=TaskStatus.NOT_ALLOWED.value,
+                    log=str(e),
+                    finished_at=time.time(),
+                )
+                return
+            except UnknownAlgorithm as e:
+                patch(
+                    status=TaskStatus.NO_IMAGE.value,
+                    log=str(e),
+                    finished_at=time.time(),
+                )
+                return
         patch(status=TaskStatus.ACTIVE.value, started_at=time.time())
         if self.vpn.enabled:
             # register the algorithm's declared ports (module EXPOSED_PORTS;
@@ -414,6 +648,7 @@ class NodeDaemon:
                 run_id=run_id,
                 task_id=task["id"],
                 image=task["image"],
+                engine=task.get("engine") or "process",
                 method=payload.get("method", task["method"]),
                 input_payload=payload,
                 databases=task.get("databases") or [],
@@ -430,6 +665,8 @@ class NodeDaemon:
                     "init_user": str(task.get("init_user", {}).get("id", "")),
                 },
             )
+            if spec.engine == "device" and self.runner.device_engine:
+                self._await_device_peers(task, run_id)
             result = self.runner.run(spec)
         except PolicyViolation as e:
             patch(

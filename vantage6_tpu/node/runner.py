@@ -30,6 +30,14 @@ from vantage6_tpu.node.gates import OutboundWhitelist, SSHTunnelManager
 log = setup_logging("vantage6_tpu/node.runner")
 
 
+import threading
+
+# One lock per PROCESS: the global device mesh is a process-wide singleton,
+# and two concurrent collective programs would interleave their rendezvous
+# and deadlock — device-engine runs execute strictly one at a time.
+_DEVICE_ENGINE_LOCK = threading.Lock()
+
+
 class PolicyViolation(Exception):
     """Algorithm/image refused by node policy (reference: NOT_ALLOWED)."""
 
@@ -55,6 +63,9 @@ class RunSpec:
     # workspace; store_as persists the returned dataframe locally
     session_id: int | None = None
     store_as: str | None = None
+    # "process" (sandbox/inline per node config) or "device": the run is one
+    # collective SPMD program over the federation's global device mesh
+    engine: str = "process"
 
 
 class TaskRunner:
@@ -68,6 +79,7 @@ class TaskRunner:
         station_secret: str | bytes | None = None,
         identity_key_path: str | None = None,
         org_identities: dict[int, str] | None = None,
+        device_engine: bool = False,
     ):
         """``algorithms`` maps image name -> importable module path.
 
@@ -99,6 +111,12 @@ class TaskRunner:
         if mode not in ("sandbox", "inline"):
             raise ValueError(f"unknown runner mode {mode!r}")
         self.mode = mode
+        # device_engine: this node's daemon owns (a slice of) the federation
+        # device mesh — it joined jax.distributed at start — and accepts
+        # engine="device" tasks. Off by default: a device task arriving at an
+        # unconfigured node is refused, not silently run on the wrong mesh.
+        self.device_engine = bool(device_engine)
+        self._marker_cache: dict[str, bool] = {}
         self.work_dir = Path(work_dir or tempfile.mkdtemp(prefix="v6t_node_"))
         self.work_dir.mkdir(parents=True, exist_ok=True)
 
@@ -130,6 +148,71 @@ class TaskRunner:
         if module is None:
             raise UnknownAlgorithm(f"no algorithm registered for {image!r}")
         return module
+
+    def has_device_marker(self, module: str) -> bool:
+        """Whether ``module`` declares ``DEVICE_ENGINE = True`` — WITHOUT
+        importing it (importing would execute its top-level code in the
+        daemon process, the very bypass the marker check exists to refuse).
+        Already-imported modules are probed live; otherwise the source is
+        parsed statically, memoized per module name (the run path checks the
+        marker both before ACTIVE and inside run(); one disk read + AST
+        parse covers the daemon's lifetime). (find_spec imports parent
+        PACKAGES — acceptable: the marker gate is about the algorithm
+        module's own code.)
+        """
+        import ast
+        import importlib.util
+
+        mod = sys.modules.get(module)
+        if mod is not None:
+            return bool(getattr(mod, "DEVICE_ENGINE", False))
+        if module in self._marker_cache:
+            return self._marker_cache[module]
+        marked = False
+        try:
+            spec = importlib.util.find_spec(module)
+        except (ImportError, ValueError):
+            spec = None
+        if spec is not None and spec.origin and spec.origin.endswith(".py"):
+            try:
+                tree = ast.parse(Path(spec.origin).read_text())
+            except (OSError, SyntaxError):
+                tree = None
+            for node in tree.body if tree else []:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]
+                if any(
+                    isinstance(t, ast.Name) and t.id == "DEVICE_ENGINE"
+                    for t in targets
+                ):
+                    marked = bool(getattr(node.value, "value", False))
+        self._marker_cache[module] = marked
+        return marked
+
+    def preflight_device(self, image: str, init_user: str | None = None) -> None:
+        """All DETERMINISTIC refusals for an engine="device" run, checkable
+        before the daemon goes ACTIVE. Peers treat ACTIVE as "this node WILL
+        enter the collective program" — any refusal discovered after ACTIVE
+        leaves them blocked inside the collectives until the comm backend
+        times out, so everything that can fail locally must fail here first.
+        Raises PolicyViolation / UnknownAlgorithm.
+        """
+        self.check_policy(image, init_user)
+        module = self.resolve(image)
+        if not self.device_engine:
+            raise PolicyViolation(
+                "this node is not configured as a device-engine mesh "
+                "member (node config: device_engine)"
+            )
+        if not self.has_device_marker(module):
+            raise PolicyViolation(
+                f"algorithm {image!r} is not a device-engine module "
+                "(no DEVICE_ENGINE marker): refusing to run it inline in "
+                "the daemon process"
+            )
 
     def algorithm_ports(self, image: str) -> list[int]:
         """Ports the algorithm declares for cross-station traffic — module
@@ -201,7 +284,17 @@ class TaskRunner:
         module = self.resolve(spec.image)
         if spec.store_as and spec.session_id is None:
             raise RuntimeError("store_as requires a session_id")
-        if self.mode == "inline":
+        if spec.engine == "device":
+            # device-engine run: the SPMD program must execute IN the daemon
+            # process (the subprocess sandbox cannot reach the devices the
+            # daemon's jax.distributed membership owns), one task at a time
+            # (collective programs cannot interleave on one mesh). The same
+            # refusals run in preflight_device (before the daemon patches
+            # ACTIVE); re-checked here so direct runner callers can't bypass.
+            self.preflight_device(spec.image, spec.metadata.get("init_user"))
+            with _DEVICE_ENGINE_LOCK:
+                result = self._run_inline(module, spec)
+        elif self.mode == "inline":
             result = self._run_inline(module, spec)
         else:
             result = self._run_sandbox(module, spec)

@@ -293,6 +293,9 @@ class Task(Model):
         "session_id": "int",  # sessions: task runs inside this workspace
         "store_as": "str",    # sessions: nodes persist the run's returned
                               # dataframe under this handle
+        "engine": "str",      # "process" (default: node sandbox/inline) or
+                              # "device": the run executes as ONE SPMD
+                              # program over the nodes' global device mesh
     }
 
     def runs(self) -> list["TaskRun"]:
@@ -339,6 +342,7 @@ class Task(Model):
             "databases": self.databases or [],
             "session": {"id": self.session_id} if self.session_id else None,
             "store_as": self.store_as or None,
+            "engine": self.engine or "process",
             "runs": [r.id for r in self.runs()],
         }
 

@@ -889,6 +889,33 @@ def register_resources(srv: "ServerApp") -> None:
             # same policy as GET /api/run: a node sees only its own org's
             # runs (others' inputs/results are not its business)
             _check(task.collaboration_id == principal.collaboration_id)
+            if (task.engine or "process") == "device":
+                # collective coordination: a member daemon decides whether
+                # to ENTER the SPMD program by watching every peer run's
+                # status (node._await_device_peers). Statuses are shared
+                # with all member nodes; payloads stay private — redact
+                # input/result/log.
+                start = (req.page - 1) * req.per_page
+                return {
+                    "data": [
+                        {
+                            "id": r.id,
+                            "task": {"id": r.task_id},
+                            "organization": {"id": r.organization_id},
+                            "node": {"id": r.node_id},
+                            "status": r.status,
+                            "assigned_at": r.assigned_at,
+                            "started_at": r.started_at,
+                            "finished_at": r.finished_at,
+                        }
+                        for r in runs[start : start + req.per_page]
+                    ],
+                    "pagination": {
+                        "page": req.page,
+                        "per_page": req.per_page,
+                        "total": len(runs),
+                    },
+                }
             runs = [
                 r for r in runs if r.organization_id == principal.organization_id
             ]
@@ -1274,6 +1301,23 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
         if not store_as.replace("_", "").replace("-", "").isalnum():
             raise HTTPError(400, "store_as must be a simple identifier")
 
+    engine = body["engine"]
+    if engine == "device":
+        # a device-engine run is ONE collective SPMD program: every process
+        # of the global device mesh must enter it, or the collectives hang.
+        # The server enforces the coarse proxy it can see — the task targets
+        # every organization of the collaboration/study.
+        targeted = {int(s["id"]) for s in org_specs}
+        if targeted != set(member_ids) or len(org_specs) != len(targeted):
+            raise HTTPError(
+                400,
+                "device-engine tasks must target every organization of the "
+                f"collaboration/study exactly once (targeted "
+                f"{sorted(int(s['id']) for s in org_specs)}, members "
+                f"{sorted(member_ids)}): the SPMD program is collective and "
+                "a duplicate run would re-enter it without peers",
+            )
+
     task = m.Task(
         name=body["name"],
         description=body["description"],
@@ -1287,6 +1331,7 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
         databases=body["databases"] or [{"label": "default"}],
         session_id=session_id,
         store_as=store_as,
+        engine=engine,
     ).save()
     if store_as is not None:
         df = m.SessionDataframe.first(

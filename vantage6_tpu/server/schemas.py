@@ -147,6 +147,13 @@ class TaskInput(_Base):
     # sessions
     session_id = fields.Int(load_default=None)
     store_as = fields.Str(load_default=None)
+    # execution engine: "process" (node-local sandbox/inline run, default)
+    # or "device" (one SPMD program over the nodes' global device mesh —
+    # every targeted node joins the same collective computation)
+    engine = fields.Str(
+        load_default=None,
+        validate=validate.OneOf(["process", "device"]),
+    )
 
 
 class RunPatch(_Base):

@@ -1,0 +1,222 @@
+"""Device-engine workloads: server-submitted tasks that execute as ONE SPMD
+program over the federation's GLOBAL device mesh.
+
+This is where the control plane meets the TPU data plane (SURVEY.md §2.4
+"orchestrator ↔ station controllers over DCN"): every targeted node daemon
+is a `jax.distributed` process (node config ``device_engine``), a task
+created with ``engine="device"`` is delivered to all of them, and each
+daemon executes the SAME method inline.  Inside, the method builds the
+global :class:`~vantage6_tpu.core.mesh.FederationMesh` (one station per
+daemon process), contributes ONLY its own station's rows via
+``stack_local_shards`` — no host ever materializes another host's data —
+and the cross-station reduction lowers to XLA collectives riding the
+inter-host fabric (Gloo on CPU, ICI/DCN on TPU pods).  Every daemon
+returns the identical replicated aggregate.
+
+Contrast with the "process" engine (``workloads/average.py``): there the
+central method fans out one subtask per organization and aggregates partial
+RESULTS over HTTP — the reference's container semantics.  Here there is no
+fan-out and no HTTP in the hot path: the round IS one jitted collective
+program spanning every daemon's devices.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vantage6_tpu.algorithm.decorators import data
+from vantage6_tpu.core import distributed as D
+from vantage6_tpu.core.mesh import FederationMesh
+
+# Marker read by the node runner: these methods must execute in the daemon
+# process (the subprocess sandbox cannot reach the daemon's mesh membership).
+DEVICE_ENGINE = True
+
+
+def federation_mesh() -> FederationMesh:
+    """The global mesh with ONE STATION PER DAEMON PROCESS.
+
+    Each process's local devices form its station's sub-mesh (tensor/model
+    parallelism within the station rides the ``device`` axis).  Global
+    device ids are assigned contiguously per process, so slot i's devices
+    belong to process i and ``local_stations(mesh) == [process_index]``.
+    """
+    n_proc = jax.process_count()
+    if jax.device_count() % n_proc:
+        raise RuntimeError(
+            f"{jax.device_count()} global devices do not divide evenly over "
+            f"{n_proc} processes: station slots would mix devices from "
+            "different daemons — give every daemon the same device count"
+        )
+    dps = jax.device_count() // n_proc
+    mesh = D.global_mesh(n_stations=n_proc, devices_per_station=dps)
+    for slot in range(mesh.station_axis_size):
+        owners = {d.process_index for d in mesh.mesh.devices[slot]}
+        if len(owners) != 1:
+            raise RuntimeError(
+                f"station slot {slot} spans processes {sorted(owners)}: "
+                "device enumeration is not contiguous per process; this "
+                "deployment cannot map one station per daemon"
+            )
+    return mesh
+
+
+def _contribute_column(
+    mesh: FederationMesh, values: np.ndarray, pad_to: int
+) -> tuple[jax.Array, jax.Array]:
+    """This daemon's column values as its station's shard of the global
+    ``[S, pad_to]`` array (zero-padded; true length carried separately)."""
+    values = np.asarray(values, np.float32)
+    if values.size > pad_to:
+        raise ValueError(
+            f"station holds {values.size} rows > pad_to={pad_to}; raise the "
+            "task's pad_to (it must be a static bound shared by all nodes)"
+        )
+    padded = np.zeros((pad_to,), np.float32)
+    padded[: values.size] = values
+    mine = D.local_stations(mesh)
+    x = D.stack_local_shards(mesh, {s: padded for s in mine})
+    n = D.stack_local_shards(
+        mesh, {s: np.asarray([values.size], np.float32) for s in mine}
+    )
+    return x, n
+
+
+@data(1)
+def device_column_stats(
+    df: Any, column: str, pad_to: int = 4096
+) -> dict[str, Any]:
+    """Federated mean/std of one column as a single collective SPMD program.
+
+    Every member daemon runs this concurrently; the per-station moments are
+    computed under ``fed_map`` (each station's block sees only its own
+    shard) and the cross-station reduction is one XLA all-reduce.  All
+    daemons return the identical replicated result — the researcher's runs
+    agree bit-for-bit.
+    """
+    mesh = federation_mesh()
+    vals = np.asarray(df[column].dropna(), np.float32)
+    x, n = _contribute_column(mesh, vals, pad_to)
+
+    # zero padding is invisible to sum/sumsq; count comes from the true n
+    moments = mesh.fed_map(
+        lambda xv, nv: jnp.stack([jnp.sum(xv), jnp.sum(xv * xv), nv[0]]),
+        x,
+        n,
+    )  # [S, 3], station-sharded
+    total = jax.jit(
+        lambda t: jnp.sum(t, axis=0),
+        out_shardings=mesh.replicated_sharding(),
+    )(moments)
+    t = np.asarray(jax.device_get(total), np.float64)
+    mean = t[0] / t[2]
+    var = max(t[1] / t[2] - mean * mean, 0.0)
+    return {
+        "mean": float(mean),
+        "std": float(var**0.5),
+        "count": int(t[2]),
+        "n_stations": int(mesh.n_stations),
+        "process_index": int(jax.process_index()),
+        "global_devices": int(jax.device_count()),
+    }
+
+
+@data(1)
+def device_logistic_fit(
+    df: Any,
+    feature_columns: list[str],
+    label_column: str,
+    rounds: int = 5,
+    local_steps: int = 4,
+    batch_rows: int = 64,
+    lr: float = 0.5,
+) -> dict[str, Any]:
+    """Federated logistic regression TRAINED as collective SPMD rounds.
+
+    Each round: every station takes ``local_steps`` full-batch gradient
+    steps on its OWN rows under ``fed_map`` (gradient isolation — see
+    mesh.py on variance checking), then the models are combined by
+    row-count-weighted mean via one all-reduce.  The loop over rounds is a
+    ``lax.scan`` — the whole training run is ONE compiled program.
+
+    ``batch_rows`` is the static per-station row bound (row padding is
+    masked out of loss and gradients).
+    """
+    mesh = federation_mesh()
+    feats = np.asarray(df[feature_columns], np.float32)
+    labels = np.asarray(df[label_column], np.float32)
+    n_rows, n_feat = feats.shape
+    if n_rows > batch_rows:
+        raise ValueError(
+            f"station holds {n_rows} rows > batch_rows={batch_rows}; raise "
+            "the task's batch_rows (static bound shared by all nodes)"
+        )
+    fx = np.zeros((batch_rows, n_feat), np.float32)
+    fx[:n_rows] = feats
+    fy = np.zeros((batch_rows,), np.float32)
+    fy[:n_rows] = labels
+    mask = np.zeros((batch_rows,), np.float32)
+    mask[:n_rows] = 1.0
+
+    mine = D.local_stations(mesh)
+    sx = D.stack_local_shards(mesh, {s: fx for s in mine})
+    sy = D.stack_local_shards(mesh, {s: fy for s in mine})
+    sm = D.stack_local_shards(mesh, {s: mask for s in mine})
+
+    def local_loss(params, xb, yb, mb):
+        w, b = params
+        logits = xb @ w + b
+        per_row = (
+            jnp.maximum(logits, 0.0)
+            - logits * yb
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        )
+        return jnp.sum(per_row * mb) / jnp.maximum(jnp.sum(mb), 1.0)
+
+    def station_round(xb, yb, mb, params):
+        def step(p, _):
+            g = jax.grad(local_loss)(p, xb, yb, mb)
+            return jax.tree.map(lambda a, ga: a - lr * ga, p, g), None
+
+        p, _ = jax.lax.scan(step, params, None, length=local_steps)
+        return p, jnp.sum(mb)
+
+    params0 = (jnp.zeros((n_feat,), jnp.float32), jnp.zeros((), jnp.float32))
+
+    # the station-sharded GLOBAL arrays must enter the jitted program as
+    # ARGUMENTS (a multi-process program cannot close over arrays whose
+    # shards live on other hosts' devices)
+    def train_impl(params, xs, ys, ms):
+        def fed_round(p, _):
+            locals_, counts = mesh.fed_map(station_round, xs, ys, ms,
+                                           replicated_args=(p,))
+            total = jnp.maximum(jnp.sum(counts), 1.0)
+
+            def wmean(leaf):
+                return jnp.tensordot(counts / total, leaf, axes=1)
+
+            return jax.tree.map(wmean, locals_), None
+
+        return jax.lax.scan(fed_round, params, None, length=rounds)[0]
+
+    train = jax.jit(
+        train_impl,
+        # replicated output: every process can device_get the full model
+        out_shardings=mesh.replicated_sharding(),
+    )
+    w, b = jax.device_get(train(params0, sx, sy, sm))
+    # accuracy on the LOCAL rows only — evaluation never crosses stations
+    logits = feats @ np.asarray(w) + float(b)
+    acc = float(np.mean((logits > 0).astype(np.float32) == labels)) \
+        if n_rows else 0.0
+    return {
+        "weights": [float(v) for v in np.asarray(w)],
+        "bias": float(b),
+        "local_accuracy": acc,
+        "local_rows": int(n_rows),
+        "n_stations": int(mesh.n_stations),
+        "process_index": int(jax.process_index()),
+    }
