@@ -44,11 +44,17 @@ class AlgorithmClient:
         self.organization = _OrganizationSubClient(self)
 
     # Reference signature: wait_for_results(task_id, interval=1) — interval
-    # is accepted for compatibility but nothing polls: execution already
-    # happened (host mode) or is an in-flight async device computation whose
-    # handle we return immediately.
-    def wait_for_results(self, task_id: int, interval: float = 1.0) -> list[Any]:
-        del interval
+    # and timeout are accepted for compatibility (the REST client needs
+    # both; algorithms pass them uniformly) but nothing polls: execution
+    # already happened (host mode) or is an in-flight async device
+    # computation whose handle we return immediately.
+    def wait_for_results(
+        self,
+        task_id: int,
+        interval: float = 1.0,
+        timeout: float = 600.0,
+    ) -> list[Any]:
+        del interval, timeout
         return self._fed.wait_for_results(task_id)
 
     def wait_for_stacked_result(self, task_id: int) -> tuple[Any, Any]:

@@ -389,6 +389,12 @@ class NodeDaemon:
         terminal status must not wedge the queue). Drains every page: the
         decisive run hiding on page 2 of a deep backlog would re-open the
         opposite-order deadlock this check exists to prevent."""
+        import jax
+
+        if jax.process_count() <= 1:
+            # single-process mesh: no peer daemon to agree with, so local
+            # queue order suffices — skip the server scan entirely
+            return None
         candidates: list[tuple[int, int]] = []
         page = 1
         while True:

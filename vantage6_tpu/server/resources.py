@@ -1306,16 +1306,19 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
         # a device-engine run is ONE collective SPMD program: every process
         # of the global device mesh must enter it, or the collectives hang.
         # The server enforces the coarse proxy it can see — the task targets
-        # every organization of the collaboration/study.
+        # every organization of the COLLABORATION (not a study subset: the
+        # mesh spans all member daemons, and a daemon outside the study
+        # would never receive a run yet its process must join the program).
         targeted = {int(s["id"]) for s in org_specs}
-        if targeted != set(member_ids) or len(org_specs) != len(targeted):
+        collab_members = set(collab.organization_ids())
+        if targeted != collab_members or len(org_specs) != len(targeted):
             raise HTTPError(
                 400,
                 "device-engine tasks must target every organization of the "
-                f"collaboration/study exactly once (targeted "
+                f"collaboration exactly once (targeted "
                 f"{sorted(int(s['id']) for s in org_specs)}, members "
-                f"{sorted(member_ids)}): the SPMD program is collective and "
-                "a duplicate run would re-enter it without peers",
+                f"{sorted(collab_members)}): the SPMD program is collective "
+                "and a duplicate or missing run would hang it",
             )
 
     task = m.Task(

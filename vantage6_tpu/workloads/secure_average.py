@@ -15,10 +15,12 @@ the encrypted task payload), log/trace readers, and any party shown a
 single masked upload. A party holding the seed (including the central
 aggregator itself) CAN regenerate the masks and unmask individual uploads.
 Defending against an untrusted aggregator requires per-pair Diffie-Hellman
-mask secrets (Bonawitz et al.) so that no single party knows all masks; the
-collective structure here is identical — only key provisioning changes, and
-that upgrade is the planned next step for this workload. Provision the seed
-out-of-band (station configs), never through an unencrypted task payload.
+mask secrets (Bonawitz et al.) so that no single party knows all masks —
+that is the `central_secure_average_dh` variant below; the full Bonawitz
+double-mask protocol with dropout recovery is `central_secure_average_
+bonawitz` (four task rounds; survives a station dying mid-protocol).
+Provision the seed out-of-band (station configs), never through an
+unencrypted task payload.
 """
 from __future__ import annotations
 
@@ -187,33 +189,8 @@ def partial_secure_average_dh(
     from vantage6_tpu.common import secureagg_dh as dh
 
     pub_map = {int(i): p for i, p in pubkeys}
-    identities = None
     sig_map = {int(i): s for i, s in (signatures or [])}
-    registry = dh.get_org_identities()
-    if registry is not None:
-        if org_ids is None:
-            raise ValueError(
-                "identity roster provisioned but task carries no org_ids — "
-                "cannot verify adverts; refusing to upload"
-            )
-        # org_ids arrives THROUGH the relay being defended against, so it
-        # cannot be trusted to pick the participant subset: a relay could
-        # shrink it to {victim} (every remaining advert validly signed) and
-        # the victim would upload with zero pairwise masks. The roster must
-        # be exactly the locally-provisioned registry — the out-of-band
-        # trust root. Subset aggregations under verification need a roster
-        # signed by the initiating user (not implemented; run the full
-        # collaboration or provision a per-study registry).
-        if {int(o) for o in org_ids} != set(registry):
-            raise ValueError(
-                "aggregation roster does not match the provisioned identity "
-                f"registry (task: {sorted(int(o) for o in org_ids)}, "
-                f"registry: {sorted(registry)}) — refusing a relay-chosen "
-                "participant subset"
-            )
-        identities = {
-            idx: registry[int(org)] for idx, org in enumerate(org_ids)
-        }
+    identities = _roster_identities(agg_tag, pub_map, org_ids, signatures)
     col = df[column]
     vec = np.clip(
         np.asarray([col.sum(), float(col.count())], np.float32),
@@ -233,6 +210,58 @@ def partial_secure_average_dh(
     return {"masked": masked, "party_index": party_index}
 
 
+def _roster_identities(
+    agg_tag: str,
+    pub_map: dict[int, str],
+    org_ids: list[int] | None,
+    signatures: list[list[Any]] | None,
+    verify_now: bool = False,
+) -> dict[int, str] | None:
+    """Shared fail-closed roster resolution for the DH/Bonawitz partials.
+
+    ``org_ids`` arrives THROUGH the relay being defended against, so it
+    cannot be trusted to pick the participant subset: a relay could shrink
+    it to {victim} (every remaining advert validly signed) and the victim
+    would upload with zero pairwise masks. With a locally provisioned
+    identity registry the roster must therefore equal the registry exactly
+    — the out-of-band trust root. Subset aggregations under verification
+    need a roster signed by the initiating user (not implemented; run the
+    full collaboration or provision a per-study registry).
+
+    Returns the {party_index -> identity pubkey} map for signature
+    verification, or None when no registry is provisioned.
+    ``verify_now=True`` additionally verifies every advert immediately
+    (rounds that consume pubkeys without masking, e.g. Bonawitz share
+    sealing, have no later verification point).
+    """
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    registry = dh.get_org_identities()
+    if registry is None:
+        return None
+    if org_ids is None:
+        raise ValueError(
+            "identity roster provisioned but task carries no org_ids — "
+            "cannot verify adverts; refusing to proceed"
+        )
+    if {int(o) for o in org_ids} != set(registry):
+        raise ValueError(
+            "aggregation roster does not match the provisioned identity "
+            f"registry (task: {sorted(int(o) for o in org_ids)}, "
+            f"registry: {sorted(registry)}) — refusing a relay-chosen "
+            "participant subset"
+        )
+    identities = {idx: registry[int(org)] for idx, org in enumerate(org_ids)}
+    if verify_now:
+        dh.verify_adverts(
+            pub_map,
+            identities,
+            {int(i): s for i, s in (signatures or [])},
+            agg_tag,
+        )
+    return identities
+
+
 @algorithm_client
 def central_secure_average_dh(
     client: Any,
@@ -248,8 +277,8 @@ def central_secure_average_dh(
     uploading — a key-substituting (active MitM) relay makes the round fail
     closed (tests/test_secureagg_dh.py::TestSignedAdverts; THREAT_MODEL.md).
 
-    No dropout recovery: every advertiser must upload (see
-    common.secureagg_bonawitz for the recovering variant) — a missing
+    No dropout recovery: every advertiser must upload (use
+    central_secure_average_bonawitz for the recovering variant) — a missing
     upload leaves masks uncancelled and the round is retried.
     """
     import secrets
@@ -320,4 +349,317 @@ def central_secure_average_dh(
     return {
         "average": g_sum / g_count if g_count else float("nan"),
         "count": int(round(g_count)),
+    }
+
+
+# --------------------------------------------------------------------------
+# Dropout-recoverable variant: the FULL Bonawitz double-mask construction
+# (common.secureagg_bonawitz) driven as real task rounds through the normal
+# control plane: advertise -> share -> upload -> reveal. A station that
+# dies between sharing and uploading no longer spoils the aggregate: any
+# `threshold` survivors hand the aggregator the dropped station's key-seed
+# shares and the orphaned pairwise masks are stripped, while the double
+# mask keeps a LYING aggregator from unmasking an upload it already holds
+# (reference protocol: SURVEY.md:158; library tests:
+# tests/test_secureagg_bonawitz.py).
+#
+# Round contract: every station must COMPLETE the share round — a failure
+# there aborts the aggregation (shares are Shamir-split over the full
+# roster, so excluding a station post-hoc would desynchronize share
+# x-coordinates). Dropout tolerance begins once shares are distributed,
+# which is exactly the Bonawitz round structure.
+# --------------------------------------------------------------------------
+
+
+def partial_bonawitz_shares(
+    party_index: int,
+    pubkeys: list[list[Any]],
+    agg_tag: str,
+    threshold: int,
+    org_ids: list[int] | None = None,
+    signatures: list[list[Any]] | None = None,
+) -> dict[str, Any]:
+    """Round 2: Shamir-share this station's key seed AND self-mask seed
+    among its peers, each share sealed to its recipient (the relay sees
+    ciphertext). Adverts are verified IMMEDIATELY when an identity roster
+    is provisioned — this round seals secrets to the advertised keys, so a
+    substituted advert must abort here, not at upload."""
+    from vantage6_tpu.common import secureagg_bonawitz as bz
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    pub_map = {int(i): p for i, p in pubkeys}
+    _roster_identities(agg_tag, pub_map, org_ids, signatures, verify_now=True)
+    blobs = bz.make_recovery_shares(
+        dh.get_station_secret(), party_index, pub_map, agg_tag, threshold
+    )
+    return {
+        "party_index": party_index,
+        "blobs": [[int(peer), blob] for peer, blob in sorted(blobs.items())],
+    }
+
+
+@data(1)
+def partial_secure_average_bonawitz(
+    df: Any,
+    column: str,
+    party_index: int,
+    pubkeys: list[list[Any]],
+    scale: float,
+    max_abs: float,
+    agg_tag: str,
+    org_ids: list[int] | None = None,
+    signatures: list[list[Any]] | None = None,
+) -> dict[str, Any]:
+    """Round 3: the DOUBLE-masked upload = quantized [sum, count] + this
+    station's self-mask stream + signed pairwise streams. Same clipping
+    contract as the other variants; same fail-closed advert verification
+    as the DH upload."""
+    from vantage6_tpu.common import secureagg_bonawitz as bz
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    pub_map = {int(i): p for i, p in pubkeys}
+    identities = _roster_identities(agg_tag, pub_map, org_ids, signatures)
+    col = df[column]
+    vec = np.clip(
+        np.asarray([col.sum(), float(col.count())], np.float32),
+        -max_abs,
+        max_abs,
+    )
+    masked = bz.mask_update_bonawitz(
+        dh.get_station_secret(),
+        party_index,
+        pub_map,
+        vec,
+        scale,
+        tag=agg_tag,
+        identities=identities,
+        signatures={int(i): s for i, s in (signatures or [])},
+    )
+    return {"masked": masked, "party_index": party_index}
+
+
+def partial_bonawitz_reveal(
+    party_index: int,
+    pubkeys: list[list[Any]],
+    blobs_from: list[list[Any]],
+    survivors: list[int],
+    agg_tag: str,
+    threshold: int,
+    org_ids: list[int] | None = None,
+    signatures: list[list[Any]] | None = None,
+) -> dict[str, Any]:
+    """Round 4 (survivors only): open the share blobs peers sealed to me
+    and reveal, per origin, EITHER its self-mask share (origin uploaded)
+    OR its key-seed share (origin dropped) — never both; the library
+    enforces the invariant that protects uploads from a lying aggregator.
+    Runs even with zero dropouts: self-masks must always be stripped."""
+    from vantage6_tpu.common import secureagg_bonawitz as bz
+    from vantage6_tpu.common import secureagg_dh as dh
+
+    pub_map = {int(i): p for i, p in pubkeys}
+    _roster_identities(agg_tag, pub_map, org_ids, signatures, verify_now=True)
+    reveals = bz.reveal_for_recovery(
+        dh.get_station_secret(),
+        party_index,
+        pub_map,
+        {int(i): b for i, b in blobs_from},
+        [int(s) for s in survivors],
+        agg_tag,
+        threshold,
+    )
+    return {
+        "party_index": party_index,
+        "reveals": [
+            [int(origin), kind, share]
+            for origin, (kind, share) in sorted(reveals.items())
+        ],
+    }
+
+
+@algorithm_client
+def central_secure_average_bonawitz(
+    client: Any,
+    column: str,
+    organizations: list[int] | None = None,
+    max_abs: float = 2.0**24,
+    threshold: int | None = None,
+    upload_timeout: float = 120.0,
+    poll_interval: float = 1.0,
+) -> dict[str, Any]:
+    """Dropout-recoverable secure average: the Bonawitz protocol as four
+    task rounds. Against an untrusted aggregator AND station failures:
+
+    - this central (and the relaying server) sees only public keys,
+      sealed share blobs, double-masked uploads and either/or reveals —
+      never an individual station's [sum, count];
+    - a station that dies after sharing but before uploading is declared
+      dropped once ``upload_timeout`` passes; the survivors' reveal round
+      lets the aggregate complete EXACTLY over the survivor set.
+
+    Aborts (for retry) if any station fails the advertise or share round,
+    or if fewer than ``threshold`` stations upload.
+    """
+    import secrets
+
+    from vantage6_tpu.common import secureagg_bonawitz as bz
+
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    n = len(orgs)
+    if n < 2:
+        raise ValueError(
+            "secure aggregation needs >= 2 parties (and >= 3 for any "
+            "dropout tolerance: majority threshold with n=2 is 2)"
+        )
+    t = bz.default_threshold(n) if threshold is None else threshold
+    scale = 2.0**30 / (n * max_abs)
+    agg_tag = secrets.token_hex(16)
+    org_ids = [int(o) for o in orgs]
+
+    def fanout(method: str, per_org_kwargs, targets, name: str):
+        subs = []
+        for idx, org in targets:
+            subs.append(
+                (
+                    idx,
+                    org,
+                    client.task.create(
+                        input_={
+                            "method": method,
+                            "kwargs": per_org_kwargs(idx),
+                        },
+                        organizations=[org],
+                        name=f"{name}_{idx}",
+                    ),
+                )
+            )
+        return subs
+
+    def collect(subs, timeout=600.0):
+        out = {}
+        for idx, org, sub in subs:
+            out[idx] = client.wait_for_results(
+                task_id=sub["id"] if isinstance(sub, dict) else sub.id,
+                interval=poll_interval,
+                timeout=timeout,
+            )[0]
+        return out
+
+    everyone = list(enumerate(orgs))
+
+    # round 1: per-aggregation X25519 adverts (+ signatures when stations
+    # provision identities)
+    adverts = collect(
+        fanout(
+            "partial_advertise_mask_key",
+            lambda idx: {"party_index": idx, "agg_tag": agg_tag},
+            everyone,
+            "bz_advertise",
+        )
+    )
+    pubkeys = [[idx, adverts[idx]["pubkey"]] for idx, _ in everyone]
+    signatures = [
+        [idx, adverts[idx]["signature"]]
+        for idx, _ in everyone
+        if adverts[idx].get("signature")
+    ]
+
+    # round 2: encrypted recovery shares, relayed blind. ALL must complete.
+    share_results = collect(
+        fanout(
+            "partial_bonawitz_shares",
+            lambda idx: {
+                "party_index": idx,
+                "pubkeys": pubkeys,
+                "agg_tag": agg_tag,
+                "threshold": t,
+                "org_ids": org_ids,
+                "signatures": signatures,
+            },
+            everyone,
+            "bz_share",
+        )
+    )
+    # redistribute: blobs addressed TO station j, keyed by origin
+    blobs_to: dict[int, list[list[Any]]] = {idx: [] for idx, _ in everyone}
+    for origin, _ in everyone:
+        for peer, blob in share_results[origin]["blobs"]:
+            blobs_to[int(peer)].append([origin, blob])
+
+    # round 3: double-masked uploads; a timeout/failure here is a DROPOUT,
+    # not an abort — that is the point of the protocol
+    upload_subs = fanout(
+        "partial_secure_average_bonawitz",
+        lambda idx: {
+            "column": column,
+            "party_index": idx,
+            "pubkeys": pubkeys,
+            "scale": scale,
+            "max_abs": max_abs,
+            "agg_tag": agg_tag,
+            "org_ids": org_ids,
+            "signatures": signatures,
+        },
+        everyone,
+        "bz_upload",
+    )
+    uploads: dict[int, np.ndarray] = {}
+    dropped_orgs: list[int] = []
+    for idx, org, sub in upload_subs:
+        try:
+            r = client.wait_for_results(
+                task_id=sub["id"] if isinstance(sub, dict) else sub.id,
+                interval=poll_interval,
+                timeout=upload_timeout,
+            )[0]
+            uploads[idx] = np.asarray(r["masked"], np.int32)
+        except (TimeoutError, RuntimeError):
+            dropped_orgs.append(int(org))
+    survivors = sorted(uploads)
+    if len(survivors) < t:
+        raise RuntimeError(
+            f"only {len(survivors)} uploads < threshold {t}: aggregation "
+            "unrecoverable; retry with the surviving stations"
+        )
+
+    # round 4: survivors reveal (self-mask shares for survivors, key-seed
+    # shares for the dropped) — required even with zero dropouts
+    reveal_results = collect(
+        fanout(
+            "partial_bonawitz_reveal",
+            lambda idx: {
+                "party_index": idx,
+                "pubkeys": pubkeys,
+                "blobs_from": blobs_to[idx],
+                "survivors": survivors,
+                "agg_tag": agg_tag,
+                "threshold": t,
+                "org_ids": org_ids,
+                "signatures": signatures,
+            },
+            [(idx, org) for idx, org in everyone if idx in uploads],
+            "bz_reveal",
+        )
+    )
+    reveals = {
+        idx: {
+            int(origin): (kind, share)
+            for origin, kind, share in reveal_results[idx]["reveals"]
+        }
+        for idx in reveal_results
+    }
+
+    total = bz.recover_sum(
+        uploads,
+        {int(i): p for i, p in pubkeys},
+        reveals,
+        agg_tag,
+        threshold=t,
+        scale=scale,
+    )
+    g_sum, g_count = float(total[0]), float(total[1])
+    return {
+        "average": g_sum / g_count if g_count else float("nan"),
+        "count": int(round(g_count)),
+        "n_parties": n,
+        "dropped": sorted(dropped_orgs),
     }
