@@ -151,3 +151,43 @@ class TestFlashAndMixedPrecision:
         params, opt = eng.init(jax.random.key(5))
         params, opt, loss = eng.round(params, opt, sharded, jnp.ones(2))
         assert np.isfinite(float(loss))
+
+
+class TestStationPacking:
+    """stations_per_slot > 1: more stations than device slots fold into
+    each slot via an inner vmap (FederationMesh.fed_map contract) — one
+    chip can run an S-station federated round. The packed round must be
+    BIT-COMPATIBLE with the unpacked one: packing is an execution layout,
+    not a math change."""
+
+    def _cfg(self):
+        return FT.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                    n_layers=2, max_len=32)
+
+    def _one_round(self, n_devices):
+        cfg = self._cfg()
+        eng = FT.make_engine(
+            n_stations=4, seq_devices=1, cfg=cfg, lr=3e-3,
+            devices=jax.devices()[:n_devices],
+        )
+        tokens = eng.shard_tokens(
+            FT.make_federated_tokens(4, batch=2, seq_len=32, vocab=64)
+        )
+        params, opt = eng.init(jax.random.key(7))
+        mask = jnp.ones(4)
+        p, _, loss = eng.round(params, opt, tokens, mask)
+        return jax.device_get(p), float(loss)
+
+    def test_packed_matches_unpacked(self):
+        p4, l4 = self._one_round(4)   # one station per slot
+        p1, l1 = self._one_round(1)   # all 4 stations packed on one device
+        p2, l2 = self._one_round(2)   # 2 per slot
+        assert np.isfinite(l4)
+        np.testing.assert_allclose(l1, l4, rtol=1e-5)
+        np.testing.assert_allclose(l2, l4, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6)
+
+    def test_too_few_devices_for_seq_shards_rejected(self):
+        with pytest.raises(ValueError, match="sequence shards"):
+            FT.make_engine(n_stations=1, seq_devices=64, cfg=self._cfg())

@@ -110,6 +110,92 @@ class TestAdminScreensAPI:
         assert sorted(got["rules"]) == sorted(pick)
 
 
+class TestWizardStudySessionScreens:
+    """Round-4 UI surface: the store-metadata task wizard, study screens
+    and session screens — markup + the API contracts the page JS drives."""
+
+    def test_markup_present(self, srv):
+        page = srv.test_client().get("/").body.decode()
+        for anchor in (
+            'id="t_algo"', 'id="t_wizard"', 'id="w_function"', 'id="w_args"',
+            'id="t_study"', 'id="t_session"', 'id="t_store_as"',
+            'id="studies"', 'id="st_create"', 'id="st_orgs"',
+            'id="sessions"', 'id="se_create"', 'id="se_scope"',
+            "loadWizardAlgos", "wizardKwargs", "renderWizardArgs",
+            "deleteSession",
+        ):
+            assert anchor in page, anchor
+
+    def test_wizard_arg_types_covered(self, srv):
+        """The wizard's typed-input builder handles every Argument.TYPE the
+        store can declare — a new store type must get a form mapping."""
+        from vantage6_tpu.store.models import Argument
+
+        page = srv.test_client().get("/").body.decode()
+        for t in Argument.TYPES:
+            assert f'"{t}"' in page, f"wizard does not handle type {t!r}"
+
+    def test_study_screen_flow(self, srv):
+        c = _login(srv)
+        orgs = [
+            c.post("/api/organization", {"name": f"st_org{i}"}).json
+            for i in range(3)
+        ]
+        collab = c.post(
+            "/api/collaboration",
+            {"name": "st_collab",
+             "organization_ids": [o["id"] for o in orgs]},
+        ).json
+        # page payload shape: name, collaboration_id, organization_ids
+        made = c.post(
+            "/api/study",
+            {"name": "ui_study", "collaboration_id": collab["id"],
+             "organization_ids": [orgs[0]["id"], orgs[1]["id"]]},
+        )
+        assert made.status == 201
+        # the table renderer reads id/name/collaboration/organizations
+        row = next(
+            s for s in c.get("/api/study").json["data"]
+            if s["name"] == "ui_study"
+        )
+        assert row["collaboration"] == collab["id"]
+        assert sorted(row["organizations"]) == sorted(
+            [orgs[0]["id"], orgs[1]["id"]]
+        )
+        # the task form targets the STUDY's organizations
+        got = c.get(f"/api/study/{row['id']}").json
+        assert sorted(got["organizations"]) == sorted(row["organizations"])
+
+    def test_session_screen_flow(self, srv):
+        c = _login(srv)
+        org = c.post("/api/organization", {"name": "se_org"}).json
+        collab = c.post(
+            "/api/collaboration",
+            {"name": "se_collab", "organization_ids": [org["id"]]},
+        ).json
+        made = c.post(
+            "/api/session",
+            {"name": "ui_session", "collaboration_id": collab["id"],
+             "scope": "collaboration"},
+        )
+        assert made.status == 201
+        # renderer reads id/name/collaboration.id/scope/dataframes
+        row = next(
+            s for s in c.get("/api/session").json["data"]
+            if s["name"] == "ui_session"
+        )
+        assert row["collaboration"]["id"] == collab["id"]
+        assert row["scope"] == "collaboration"
+        assert row["dataframes"] == []
+        assert c.open(
+            "DELETE", f"/api/session/{row['id']}"
+        ).status in (200, 204)
+        assert not any(
+            s["name"] == "ui_session"
+            for s in c.get("/api/session").json["data"]
+        )
+
+
 class TestJSContractDrift:
     """VERDICT r2 weak #8: drive the CRUD flow with the payload shapes
     EXTRACTED from the rendered page's JS — if the page's api("POST", ...)

@@ -1,0 +1,126 @@
+"""One GUARDED compiled-Pallas attempt on the real chip (VERDICT r3 #6).
+
+The flash kernel (ops/flash_attention.py) has only ever run in interpret
+mode on this runtime because executing any compiled ``pallas_call`` over
+the axon TPU tunnel has wedged the tunnel machine-wide (documented in
+.claude/skills/verify/SKILL.md and bench.py). This tool records the
+evidence either way, without booby-trapping routine benches:
+
+- ``python tools/flash_attempt.py --child`` is the sacrificial subprocess:
+  it compiles and executes the kernel on the default (TPU) backend and
+  prints one JSON line with numerics-vs-reference and timing.
+- ``python tools/flash_attempt.py`` is the guard: runs the child under a
+  hard timeout, kills it on hang, probes tunnel health afterwards, and
+  writes the outcome to FLASH_ATTEMPT.json at the repo root. bench.py
+  folds that artifact into its output so the driver's BENCH_r{N}.json
+  carries the recorded outcome.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+ARTIFACT = REPO / "FLASH_ATTEMPT.json"
+CHILD_TIMEOUT_S = 300  # first TPU compile is 20-40s; 5 min is generous
+PROBE_TIMEOUT_S = 120
+
+
+def child() -> None:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vantage6_tpu.ops.flash_attention import flash_attention, reference
+
+    platform = jax.devices()[0].platform
+    b, h, t, d = 1, 2, 256, 64
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.bfloat16)
+        for _ in range(3)
+    )
+    t0 = time.perf_counter()
+    out = flash_attention(q, k, v, causal=True, interpret=False)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = flash_attention(q, k, v, causal=True, interpret=False)
+    jax.block_until_ready(out)
+    exec_s = time.perf_counter() - t0
+    ref = reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    print(json.dumps({
+        "ok": bool(err < 0.1),
+        "platform": platform,
+        "max_abs_err_vs_reference": round(err, 5),
+        "compile_seconds": round(compile_s, 1),
+        "exec_ms": round(1e3 * exec_s, 2),
+        "shape": [b, h, t, d],
+        "dtype": "bfloat16",
+    }))
+
+
+def probe() -> str:
+    """Is the tunnel still alive after the attempt?"""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
+        "jax.block_until_ready(x);"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        if p.returncode == 0:
+            return f"alive ({p.stdout.strip()})"
+        return f"broken (exit {p.returncode}): {p.stderr[-300:]}"
+    except subprocess.TimeoutExpired:
+        return f"WEDGED (probe hung > {PROBE_TIMEOUT_S}s)"
+
+
+def main() -> None:
+    started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    outcome: dict = {"attempted_at": started, "child_timeout_s": CHILD_TIMEOUT_S}
+    try:
+        p = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()), "--child"],
+            capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+            env={**os.environ},
+        )
+        if p.returncode == 0 and p.stdout.strip():
+            outcome["result"] = json.loads(p.stdout.strip().splitlines()[-1])
+            r = outcome["result"]
+            outcome["flash"] = (
+                f"ok: {r['exec_ms']} ms, max err {r['max_abs_err_vs_reference']}"
+                if r["ok"] else f"numerics mismatch: {r}"
+            )
+        else:
+            outcome["flash"] = (
+                f"child exited {p.returncode}: {(p.stderr or p.stdout)[-500:]}"
+            )
+    except subprocess.TimeoutExpired:
+        outcome["flash"] = (
+            f"HUNG: compiled pallas_call did not complete within "
+            f"{CHILD_TIMEOUT_S}s; child killed"
+        )
+    outcome["tunnel_after"] = probe()
+    ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
+    print(json.dumps(outcome))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child()
+    else:
+        main()

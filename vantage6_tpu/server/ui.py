@@ -104,13 +104,63 @@ a { color:var(--accent); cursor:pointer; }
       <h2>New task</h2>
       <div class="row">
         <select id="t_collab"></select>
+        <select id="t_study" title="target a study subset">
+          <option value="">whole collaboration</option></select>
+        <select id="t_algo" title="pick an approved store algorithm to get
+a guided form, or stay freeform">
+          <option value="">freeform algorithm</option></select>
+      </div>
+      <div class="row" id="t_freeform" style="margin-top:.5rem">
         <input id="t_image" placeholder="algorithm image" size="22">
         <input id="t_method" placeholder="method" size="16">
         <input id="t_kwargs" placeholder='kwargs JSON, e.g. {"column":"age"}'
                size="30">
+      </div>
+      <div id="t_wizard" class="hidden" style="margin-top:.5rem">
+        <div class="row">
+          <select id="w_function"></select>
+          <span id="w_fndesc" class="who"></span>
+        </div>
+        <div id="w_args" class="row" style="margin-top:.4rem"></div>
+      </div>
+      <div class="row" style="margin-top:.5rem">
+        <select id="t_session"><option value="">no session</option></select>
+        <input id="t_store_as" size="18"
+               placeholder="store as (session dataframe)">
         <button id="t_create">Create</button>
       </div>
       <div id="taskerr" class="err"></div>
+    </div>
+    <div class="panel">
+      <h2>Studies</h2>
+      <table id="studies"><thead><tr>
+        <th>id</th><th>name</th><th>collaboration</th><th>organizations</th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.6rem">
+        <input id="st_name" placeholder="study name" size="18">
+        <select id="st_collab"></select>
+        <select id="st_orgs" multiple size="3"
+                title="member organizations (ctrl-click for several)"></select>
+        <button id="st_create">Create study</button>
+      </div>
+      <div id="studyerr" class="err"></div>
+    </div>
+    <div class="panel">
+      <h2>Sessions</h2>
+      <table id="sessions"><thead><tr>
+        <th>id</th><th>name</th><th>collaboration</th><th>scope</th>
+        <th>dataframes</th><th></th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.6rem">
+        <input id="se_name" placeholder="session name" size="18">
+        <select id="se_collab"></select>
+        <select id="se_scope">
+          <option value="collaboration">collaboration</option>
+          <option value="own">own</option>
+        </select>
+        <button id="se_create">Create session</button>
+      </div>
+      <div id="sesserr" class="err"></div>
     </div>
     <div class="panel">
       <h2>Tasks</h2>
@@ -218,10 +268,29 @@ function fill(tableId, rows, renderer) {
   $(tableId).querySelector("tbody").innerHTML = rows.map(renderer).join("");
 }
 
+let collabCache = [];
+
+function keepSelection(sel, html) {
+  // refresh() reruns every 3 s: rebuilding <option>s must not clobber what
+  // the user picked mid-form (including ctrl-click MULTI-selections)
+  const prev = new Set(
+    [...sel.selectedOptions].map((o) => o.value));
+  sel.innerHTML = html;
+  let any = false;
+  for (const o of sel.options) {
+    if (prev.has(o.value)) { o.selected = true; any = true; }
+    else if (sel.multiple) o.selected = false;
+  }
+  if (!any && !sel.multiple && sel.options.length) sel.selectedIndex = 0;
+}
+
 async function refresh() {
-  const [nodes, collabs, tasks] = await Promise.all([
+  const [nodes, collabs, tasks, studies, sessions] = await Promise.all([
     api("GET", "node"), api("GET", "collaboration"), api("GET", "task"),
+    api("GET", "study").catch(() => ({ data: [] })),
+    api("GET", "session").catch(() => ({ data: [] })),
   ]);
+  collabCache = collabs.data;
   fill("nodes", nodes.data, (n) =>
     `<tr><td>${esc(n.name)}</td><td>${esc(n.organization.id)}</td>` +
     `<td>${esc(n.collaboration.id)}</td><td>${badge(n.status)}</td></tr>`);
@@ -230,13 +299,54 @@ async function refresh() {
     `<td>${esc(c.organizations.join(", "))}</td></tr>`);
   // encrypted collaborations need client-side key material the browser UI
   // does not hold — exclude them from task submission
-  $("t_collab").innerHTML = collabs.data.filter((c) => !c.encrypted).map(
+  const collabOpts = collabs.data.filter((c) => !c.encrypted).map(
     (c) => `<option value="${Number(c.id)}">${esc(c.name)}</option>`).join("");
+  keepSelection($("t_collab"), collabOpts);
+  keepSelection($("st_collab"), collabOpts);
+  keepSelection($("se_collab"), collabOpts);
+  fillStudyOrgs();
+  // only studies/sessions OF the selected collaboration: anything else
+  // would 400 at submit ("study not in collaboration")
+  const tc = parseInt($("t_collab").value, 10);
+  keepSelection($("t_study"),
+    `<option value="">whole collaboration</option>` +
+    studies.data.filter((s) => s.collaboration === tc).map((s) =>
+      `<option value="${Number(s.id)}">${esc(s.name)}</option>`).join(""));
+  keepSelection($("t_session"),
+    `<option value="">no session</option>` +
+    sessions.data.filter((s) => s.collaboration.id === tc).map((s) =>
+      `<option value="${Number(s.id)}">${esc(s.name)}</option>`).join(""));
+  fill("studies", studies.data, (s) =>
+    `<tr><td>${Number(s.id)}</td><td>${esc(s.name)}</td>` +
+    `<td>${esc(s.collaboration)}</td>` +
+    `<td>${esc((s.organizations || []).join(", "))}</td></tr>`);
+  fill("sessions", sessions.data, (s) =>
+    `<tr><td>${Number(s.id)}</td><td>${esc(s.name)}</td>` +
+    `<td>${esc(s.collaboration.id)}</td><td>${esc(s.scope)}</td>` +
+    `<td>${esc((s.dataframes || []).map((d) =>
+        d.handle + (d.ready ? " ✓" : " …")).join(", "))}</td>` +
+    `<td><button class="ghost" onclick="deleteSession(${Number(s.id)})">` +
+    `delete</button></td></tr>`);
   fill("tasks", tasks.data.slice().reverse(), (t) =>
     `<tr><td><a onclick="showTask(${Number(t.id)})">${Number(t.id)}</a></td>` +
     `<td>${esc(t.name)}</td><td>${esc(t.image)}</td>` +
     `<td>${esc(t.method || "")}</td><td>${badge(t.status)}</td></tr>`);
 }
+
+function fillStudyOrgs() {
+  const collab = collabCache.find(
+    (c) => c.id === parseInt($("st_collab").value, 10));
+  keepSelection($("st_orgs"), (collab ? collab.organizations : []).map(
+    (id) => `<option value="${Number(id)}">org ${Number(id)}</option>`
+  ).join(""));
+}
+$("st_collab").onchange = fillStudyOrgs;
+$("t_collab").onchange = () => {
+  // org-typed wizard inputs and the study/session dropdowns are all scoped
+  // to the selected collaboration — rebuild them on switch
+  renderWizardArgs();
+  refresh().catch(() => {});
+};
 
 window.showTask = async function (id) {
   const runs = await api("GET", `task/${id}/run`);
@@ -366,6 +476,7 @@ async function enter() {
   $("appview").classList.remove("hidden");
   $("logout").classList.remove("hidden");
   await refresh();
+  loadWizardAlgos();  // once per session; the 3 s poll must not hit the store
 }
 
 $("signin").onclick = async () => {
@@ -386,23 +497,177 @@ $("logout").onclick = () => {
   sessionStorage.removeItem("v6t_token"); location.reload();
 };
 
+// --------------------------------------------------- task wizard (store)
+// Approved store algorithms carry full function/argument metadata
+// (reference: the Angular UI's "task wizard" builds its form from exactly
+// this); picking one swaps the freeform inputs for a typed form.
+let wizardAlgos = [];
+
+async function loadWizardAlgos() {
+  try {
+    const info = await api("GET", "store");
+    if (!info.url) return;
+    const algos = await api("GET", "store/algorithm");
+    wizardAlgos = algos.data.filter((a) => a.status === "approved");
+    $("t_algo").innerHTML = `<option value="">freeform algorithm</option>` +
+      wizardAlgos.map((a) =>
+        `<option value="${Number(a.id)}">${esc(a.name)} (${esc(a.image)})` +
+        `</option>`).join("");
+  } catch (e) { /* store unreachable: freeform still works */ }
+}
+
+function wizardAlgo() {
+  return wizardAlgos.find((a) => a.id === parseInt($("t_algo").value, 10));
+}
+
+$("t_algo").onchange = () => {
+  const algo = wizardAlgo();
+  $("t_freeform").classList.toggle("hidden", !!algo);
+  $("t_wizard").classList.toggle("hidden", !algo);
+  if (!algo) return;
+  $("w_function").innerHTML = (algo.functions || []).map((f) =>
+    `<option value="${esc(f.name)}">${esc(f.display_name || f.name)}` +
+    ` [${esc(f.type)}]</option>`).join("");
+  renderWizardArgs();
+};
+$("w_function").onchange = () => renderWizardArgs();
+
+function wizardFunction() {
+  const algo = wizardAlgo();
+  return algo && (algo.functions || []).find(
+    (f) => f.name === $("w_function").value);
+}
+
+function argInput(a) {
+  const id = `wa_${esc(a.name)}`;
+  const ph = esc(a.display_name || a.name) +
+    (a.has_default ? ` (default ${esc(JSON.stringify(a.default))})` : "");
+  const title = esc(a.description || a.name);
+  if (a.type === "boolean")
+    return `<label title="${title}"><input type="checkbox" id="${id}"` +
+      `${a.default ? " checked" : ""}> ${esc(a.name)}</label>`;
+  if (a.type === "organization" || a.type === "organization_list") {
+    const collab = collabCache.find(
+      (c) => c.id === parseInt($("t_collab").value, 10));
+    const opts = (collab ? collab.organizations : []).map(
+      (o) => `<option value="${Number(o)}">org ${Number(o)}</option>`).join("");
+    const multi = a.type === "organization_list" ? " multiple size=3" : "";
+    return `<select id="${id}" title="${title}"${multi}>${opts}</select>`;
+  }
+  // "string" and "column" are free text; "integer"/"float" parse at submit
+  const size = a.type === "json" ? 28 :
+    (a.type === "string" || a.type === "column") ? 16 :
+    (a.type === "integer" || a.type === "float") ? 8 : 14;
+  return `<input id="${id}" placeholder="${ph}" title="${title}"` +
+    ` size="${size}">`;
+}
+
+function renderWizardArgs() {
+  const fn = wizardFunction();
+  $("w_fndesc").textContent = fn ? (fn.description || "") : "";
+  $("w_args").innerHTML =
+    (fn ? fn.arguments || [] : []).map(argInput).join(" ");
+}
+
+function wizardKwargs() {
+  const fn = wizardFunction();
+  const kwargs = {};
+  for (const a of fn.arguments || []) {
+    const el = $(`wa_${a.name}`);
+    if (!el) continue;
+    if (a.type === "boolean") { kwargs[a.name] = el.checked; continue; }
+    if (a.type === "organization_list") {
+      const ids = [...el.selectedOptions].map((o) => parseInt(o.value, 10));
+      if (ids.length || !a.has_default) kwargs[a.name] = ids;
+      continue;
+    }
+    const raw = el.value.trim();
+    if (!raw) {
+      if (!a.has_default)
+        throw new Error(`argument "${a.name}" is required`);
+      continue;  // omitted: the algorithm applies its default
+    }
+    if (a.type === "integer" || a.type === "organization")
+      kwargs[a.name] = parseInt(raw, 10);
+    else if (a.type === "float") kwargs[a.name] = parseFloat(raw);
+    else if (a.type === "json") kwargs[a.name] = JSON.parse(raw);
+    else kwargs[a.name] = raw;  // string | column
+  }
+  return kwargs;
+}
+
 $("t_create").onclick = async () => {
   try {
     $("taskerr").textContent = "";
-    let kwargs = {};
-    if ($("t_kwargs").value.trim()) kwargs = JSON.parse($("t_kwargs").value);
+    const algo = wizardAlgo();
+    let image, method, kwargs;
+    if (algo) {
+      image = algo.image;
+      method = $("w_function").value;
+      kwargs = wizardKwargs();
+    } else {
+      image = $("t_image").value;
+      method = $("t_method").value;
+      kwargs = $("t_kwargs").value.trim() ?
+        JSON.parse($("t_kwargs").value) : {};
+    }
     const collab = parseInt($("t_collab").value, 10);
-    const orgs = (await api("GET", `collaboration/${collab}`)).organizations;
-    const input = { method: $("t_method").value, kwargs };
+    const studyId = $("t_study").value ?
+      parseInt($("t_study").value, 10) : null;
+    let orgs;
+    if (studyId) {
+      orgs = (await api("GET", `study/${studyId}`)).organizations;
+    } else {
+      orgs = (await api("GET", `collaboration/${collab}`)).organizations;
+    }
+    const input = { method, kwargs };
     // unencrypted collaborations: plain base64 payload per org
     const blob = btoa(JSON.stringify(input));
-    await api("POST", "task", {
-      name: "ui task", image: $("t_image").value,
-      method: $("t_method").value, collaboration_id: collab,
+    const body = {
+      name: "ui task", image, method, collaboration_id: collab,
       organizations: orgs.map((id) => ({ id, input: blob })),
-    });
+    };
+    if (studyId) body.study_id = studyId;
+    if ($("t_session").value) {
+      body.session_id = parseInt($("t_session").value, 10);
+      if ($("t_store_as").value.trim())
+        body.store_as = $("t_store_as").value.trim();
+    }
+    await api("POST", "task", body);
     await refresh();
   } catch (e) { $("taskerr").textContent = e.message; }
+};
+
+// ----------------------------------------------------- studies & sessions
+$("st_create").onclick = async () => {
+  try {
+    $("studyerr").textContent = "";
+    await api("POST", "study", {
+      name: $("st_name").value,
+      collaboration_id: parseInt($("st_collab").value, 10),
+      organization_ids: selected("st_orgs"),
+    });
+    $("st_name").value = "";
+    await refresh();
+  } catch (e) { $("studyerr").textContent = e.message; }
+};
+
+$("se_create").onclick = async () => {
+  try {
+    $("sesserr").textContent = "";
+    await api("POST", "session", {
+      name: $("se_name").value,
+      collaboration_id: parseInt($("se_collab").value, 10),
+      scope: $("se_scope").value,
+    });
+    $("se_name").value = "";
+    await refresh();
+  } catch (e) { $("sesserr").textContent = e.message; }
+};
+
+window.deleteSession = async function (id) {
+  try { await api("DELETE", `session/${id}`); await refresh(); }
+  catch (e) { $("sesserr").textContent = e.message; }
 };
 
 api("GET", "version").then((v) => $("version").textContent = "v" + v.version);

@@ -25,7 +25,12 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from vantage6_tpu.core.mesh import _NO_VMA_KW, STATION_AXIS, shard_map
+from vantage6_tpu.core.mesh import (
+    _NO_VMA_KW,
+    STATION_AXIS,
+    _largest_divisor_leq,
+    shard_map,
+)
 from vantage6_tpu.fed import collectives
 from vantage6_tpu.ops.flash_attention import (
     flash_attention,
@@ -208,17 +213,20 @@ class FedTransformer:
         """One federated round: per-station grads (sp inside), FedAvg, step."""
 
         def station_body(params, tokens_block):
-            # tokens_block: [S/D_s, B, T/P]; this workload requires one
-            # station per mesh slot (enforced in make_engine)
-            tok = tokens_block[0]
-            loss, grads = jax.value_and_grad(loss_local)(params, tok, self.cfg)
-            # reduce over sequence shards WITHIN the station only
-            grads = lax.psum(grads, SEQ_AXIS)
-            loss = lax.pmean(loss, SEQ_AXIS)
-            return (
-                loss[None],
-                jax.tree.map(lambda g: g[None], grads),
-            )
+            # tokens_block: [S/D_s, B, T/P] — the inner vmap walks the
+            # stations PACKED into this mesh slot (stations_per_slot > 1
+            # when the mesh folds more stations than device slots, same
+            # contract as FederationMesh.fed_map)
+            def one_station(tok):
+                loss, grads = jax.value_and_grad(loss_local)(
+                    params, tok, self.cfg
+                )
+                # reduce over sequence shards WITHIN the station only
+                grads = lax.psum(grads, SEQ_AXIS)
+                loss = lax.pmean(loss, SEQ_AXIS)
+                return loss, grads
+
+            return jax.vmap(one_station)(tokens_block)
 
         # Variance checking OFF, same stance (and reason) as fed_map: the
         # station body is a purely local program whose only cross-device
@@ -256,13 +264,21 @@ def make_engine(
             "sequence-parallel runs"
         )
     devs = list(devices if devices is not None else jax.devices())
-    need = n_stations * seq_devices
-    if len(devs) < need:
+    if len(devs) < seq_devices:
         raise ValueError(
-            f"need {need} devices ({n_stations} stations x {seq_devices} "
-            f"sequence shards), have {len(devs)}"
+            f"need at least {seq_devices} devices for {seq_devices} "
+            f"sequence shards, have {len(devs)}"
         )
-    arr = np.array(devs[:need]).reshape(n_stations, seq_devices)
+    # station-axis size: the largest divisor of S that fits the hardware —
+    # remaining stations FOLD into each slot (stations_per_slot, walked by
+    # an inner vmap in round()), the same packing as FederationMesh. One
+    # chip can therefore run an S-station federated round; with S*seq
+    # devices every station owns real hardware.
+    usable_slots = len(devs) // seq_devices
+    station_slots = _largest_divisor_leq(n_stations, usable_slots)
+    arr = np.array(devs[: station_slots * seq_devices]).reshape(
+        station_slots, seq_devices
+    )
     mesh = Mesh(arr, (STATION_AXIS, SEQ_AXIS))
     return FedTransformer(mesh=mesh, cfg=cfg, optimizer=optax.adam(lr))
 

@@ -72,11 +72,13 @@ def make_federated_data(
     alpha: float = 0.5,
     seed: int = 0,
     mesh: FederationMesh | None = None,
+    noise: float = 0.7,
 ):
     """MNIST-shaped data (REAL MNIST when a local copy exists — see
     utils.datasets.load_mnist — synthetic templates otherwise), Dirichlet
-    non-iid across stations, padded + stacked (+ sharded with a mesh)."""
-    x, y = image_classes(n_stations * n_per_station, seed=seed)
+    non-iid across stations, padded + stacked (+ sharded with a mesh).
+    ``noise`` hardens the synthetic task (see utils.datasets.image_classes)."""
+    x, y = image_classes(n_stations * n_per_station, seed=seed, noise=noise)
     shards = partition_dirichlet(x, y, n_stations, alpha=alpha, seed=seed)
     sx, sy, counts = pad_shards(shards)
     if mesh is not None:
