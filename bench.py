@@ -55,6 +55,14 @@ LR = 0.05
 # On TPU a timed run is then ~180 ms — ample resolution.
 SPMD_ROUNDS = 5
 SPMD_ROUNDS_CPU = 5     # fallback: CPU execution is ~100x slower per round
+# Synthetic-task difficulty for BOTH FedAvg legs and the eval set. At the
+# historical 0.7 both paths saturate at accuracy 1.0 after 5 rounds and the
+# parity check proves nothing (VERDICT r3 weak #2). Calibrated on an
+# 8-station CPU proxy of the bench config (same local steps/batch/lr/
+# rounds/Dirichlet): noise 2.0 -> 0.81, 3.0 -> 0.51, 4.0 -> 0.26 five-round
+# accuracy; 2.0 lands in the 0.7-0.9 band where a real aggregation bug has
+# room to move the gap. Ignored when real MNIST files exist.
+SYNTH_NOISE = 2.0
 TIMED_RUNS = 3          # median of this many post-discard executions
 BASELINE_TIMING_ROUNDS = 5   # >= 5 measured rounds (VERDICT r1/r2)
 BASELINE_TIMING_STATIONS = 4  # hop-instrumented stations per timing round
@@ -195,7 +203,7 @@ def _eval_data():
     if real is not None:
         x, y = real
         return x[:4096], y[:4096]
-    return D.synthetic_image_classes(2048, seed=777)
+    return D.synthetic_image_classes(2048, seed=777, noise=SYNTH_NOISE)
 
 
 def _timed_chain(jax, step, state, n: int = TIMED_RUNS):
@@ -256,7 +264,8 @@ def worker_spmd() -> None:
         mesh, local_steps=LOCAL_STEPS, batch_size=BATCH, local_lr=LR
     )
     sx, sy, counts = W.make_federated_data(
-        N_STATIONS, n_per_station=N_PER_STATION, mesh=mesh
+        N_STATIONS, n_per_station=N_PER_STATION, mesh=mesh,
+        noise=SYNTH_NOISE,
     )
     key = jax.random.key(0)
     params = W.init_params(jax.random.fold_in(key, 1))
@@ -520,7 +529,7 @@ def worker_baseline() -> None:
         # compare IMPLEMENTATIONS, not data partitionings: Dirichlet
         # non-iid shards, padded with true counts, count-weighted mean
         sx_np, sy_np, counts = W.make_federated_data(
-            N_STATIONS, n_per_station=N_PER_STATION
+            N_STATIONS, n_per_station=N_PER_STATION, noise=SYNTH_NOISE
         )
         sx, sy = jnp.asarray(sx_np), jnp.asarray(sy_np)
         counts = jnp.asarray(counts)

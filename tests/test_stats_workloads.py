@@ -1,0 +1,106 @@
+"""Crosstab + correlation workloads: federated result == pooled oracle,
+disclosure control suppresses small cells at the station."""
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from vantage6_tpu.core.mesh import FederationMesh
+from vantage6_tpu.runtime.federation import federation_from_datasets
+from vantage6_tpu.workloads import stats
+
+
+def _run(frames, method, **kwargs):
+    fed = federation_from_datasets(frames, {"v6-stats": stats})
+    task = fed.create_task(
+        "v6-stats", {"method": method, "kwargs": kwargs}, organizations=[0]
+    )
+    return fed.wait_for_results(task.id)[0]
+
+
+class TestCrosstab:
+    def _frames(self):
+        rng = np.random.default_rng(2)
+        return [
+            pd.DataFrame({
+                "sex": rng.choice(["f", "m"], 80),
+                "outcome": rng.choice(["alive", "dead"], 80, p=[0.8, 0.2]),
+            })
+            for _ in range(3)
+        ]
+
+    def test_matches_pandas_crosstab(self):
+        frames = self._frames()
+        out = _run(frames, "central_crosstab", row_col="sex",
+                   col_col="outcome")
+        pooled = pd.concat(frames, ignore_index=True)
+        ref = pd.crosstab(pooled["sex"], pooled["outcome"])
+        for i, r in enumerate(out["rows"]):
+            for j, c in enumerate(out["columns"]):
+                assert out["table"][i][j] == int(ref.loc[r, c]), (r, c)
+
+    def test_small_cells_suppressed(self):
+        # one station holds a single rare row: with min_cell_count=5 its
+        # cell must cross the wire as -1 and poison the pooled cell to null
+        frames = self._frames()
+        frames[0] = pd.concat([
+            frames[0],
+            pd.DataFrame({"sex": ["x"], "outcome": ["alive"]}),
+        ], ignore_index=True)
+        out = _run(frames, "central_crosstab", row_col="sex",
+                   col_col="outcome", min_cell_count=5)
+        i = out["rows"].index("x")
+        j = out["columns"].index("alive")
+        assert out["table"][i][j] is None
+        # normal cells are unaffected
+        i2 = out["rows"].index("f")
+        assert isinstance(out["table"][i2][j], int)
+
+
+class TestCorrelation:
+    def _frames(self, with_nan=False):
+        rng = np.random.default_rng(4)
+        frames = []
+        for s in range(3):
+            a = rng.normal(0, 1, 70)
+            b = 0.6 * a + 0.8 * rng.normal(0, 1, 70)
+            c = rng.normal(5, 2, 70)
+            f = pd.DataFrame({"a": a, "b": b, "c": c})
+            if with_nan and s == 1:
+                f.loc[:5, "b"] = np.nan
+            frames.append(f)
+        return frames
+
+    def test_matches_pooled_pearson(self):
+        frames = self._frames()
+        out = _run(frames, "central_correlation", columns=["a", "b", "c"])
+        pooled = pd.concat(frames)
+        ref = pooled[["a", "b", "c"]].corr().to_numpy()
+        np.testing.assert_allclose(out["matrix"], ref, atol=1e-10)
+        assert out["n"] == len(pooled)
+
+    def test_complete_case_with_missing(self):
+        frames = self._frames(with_nan=True)
+        out = _run(frames, "central_correlation", columns=["a", "b", "c"])
+        pooled = pd.concat(frames).dropna()
+        ref = pooled[["a", "b", "c"]].corr().to_numpy()
+        np.testing.assert_allclose(out["matrix"], ref, atol=1e-10)
+        assert out["n"] == len(pooled)
+
+    def test_device_mode_matches_host(self):
+        frames = self._frames()
+        host = _run(frames, "central_correlation", columns=["a", "b", "c"])
+        mesh = FederationMesh(3)
+        n_max = max(len(f) for f in frames)
+        sx = np.zeros((3, n_max, 3), np.float32)
+        m = np.zeros((3, n_max), np.float32)
+        for i, f in enumerate(frames):
+            sx[i, : len(f)] = f[["a", "b", "c"]].to_numpy(np.float32)
+            m[i, : len(f)] = 1.0
+        corr = stats.correlation_device(
+            mesh, mesh.shard_stacked(jnp.asarray(sx)),
+            mesh.shard_stacked(jnp.asarray(m)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(corr, np.float64), host["matrix"], atol=2e-4
+        )

@@ -56,6 +56,10 @@ BUILTIN_ALGORITHMS = {
     "v6-kaplan-meier-py": "vantage6_tpu.workloads.survival",
     "v6-fedavg-mnist": "vantage6_tpu.workloads.fedavg_mnist",
     "v6-secure-average": "vantage6_tpu.workloads.secure_average",
+    "v6-glm-py": "vantage6_tpu.workloads.glm",
+    "v6-crosstab-py": "vantage6_tpu.workloads.stats",
+    "v6-correlation-py": "vantage6_tpu.workloads.stats",
+    "v6-device-engine": "vantage6_tpu.workloads.device_engine",
 }
 
 

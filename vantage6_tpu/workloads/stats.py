@@ -1,0 +1,176 @@
+"""Federated descriptive statistics: crosstab + correlation matrix.
+
+Parity with two more of the reference's community algorithms (SURVEY.md §2
+"algorithm repos" row):
+
+- **crosstab** (v6-crosstab-py): a contingency table over two categorical
+  columns. Each station reports category-pair COUNTS (with a configurable
+  minimum-cell-count privacy threshold, like the reference's disclosure
+  control); central sums them into the pooled table.
+- **correlation** (v6-correlation-matrix-py): the pairwise Pearson matrix
+  over numeric columns from per-station moment sums (n, Σx, Σxy) — additive
+  sufficient statistics, so the federated matrix equals the pooled one
+  computed on the concatenated rows.
+
+Both follow the standard shape: `partial_*` per station (aggregates only),
+`central_*` fanning out and combining. The correlation partial also has a
+device-mode twin computing every station's moment block as ONE SPMD
+program (`fed_map` + one all-reduce) for array-resident deployments.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vantage6_tpu.algorithm.decorators import algorithm_client, data
+from vantage6_tpu.core.mesh import FederationMesh
+from vantage6_tpu.fed.collectives import fed_sum
+
+
+# ------------------------------------------------------------------ crosstab
+@data(1)
+def partial_crosstab(
+    df: Any,
+    row_col: str,
+    col_col: str,
+    min_cell_count: int = 0,
+) -> dict[str, Any]:
+    """Category-pair counts on this station's rows.
+
+    Cells below ``min_cell_count`` are SUPPRESSED (reported as -1): the
+    reference's disclosure-control stance — a cell of 1 in a rare category
+    can identify a person. Suppression happens AT the station, before
+    anything crosses the wire.
+    """
+    counts: dict[tuple[str, str], int] = {}
+    for r, c in zip(df[row_col].astype(str), df[col_col].astype(str)):
+        counts[(r, c)] = counts.get((r, c), 0) + 1
+    cells = [
+        [r, c, (n if n >= min_cell_count else -1)]
+        for (r, c), n in sorted(counts.items())
+    ]
+    return {"cells": cells, "suppressed_below": min_cell_count}
+
+
+@algorithm_client
+def central_crosstab(
+    client: Any,
+    row_col: str,
+    col_col: str,
+    min_cell_count: int = 0,
+    organizations: list[int] | None = None,
+) -> dict[str, Any]:
+    """Pooled contingency table. A suppressed station cell poisons the
+    pooled cell (reported as null): summing around a hidden count would
+    fabricate a total."""
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    task = client.task.create(
+        input_={
+            "method": "partial_crosstab",
+            "kwargs": {
+                "row_col": row_col,
+                "col_col": col_col,
+                "min_cell_count": min_cell_count,
+            },
+        },
+        organizations=orgs,
+        name="crosstab_partial",
+    )
+    parts = client.wait_for_results(
+        task_id=task["id"] if isinstance(task, dict) else task.id
+    )
+    total: dict[tuple[str, str], int | None] = {}
+    for part in parts:
+        for r, c, n in part["cells"]:
+            key = (str(r), str(c))
+            if n < 0 or total.get(key, 0) is None:
+                total[key] = None  # suppressed anywhere -> unknown total
+            else:
+                total[key] = total.get(key, 0) + int(n)
+    rows = sorted({r for r, _ in total})
+    cols = sorted({c for _, c in total})
+    table = [
+        [total.get((r, c), 0) for c in cols]
+        for r in rows
+    ]
+    return {"rows": rows, "columns": cols, "table": table,
+            "suppressed_below": min_cell_count}
+
+
+# -------------------------------------------------------------- correlation
+@data(1)
+def partial_moments(df: Any, columns: list[str]) -> dict[str, Any]:
+    """Per-station moment block: n, Σx [p], Σ x xᵀ [p, p] over rows with no
+    missing value in ``columns`` (complete-case, like the reference)."""
+    x = np.asarray(df[columns], np.float64)
+    keep = ~np.isnan(x).any(axis=1)
+    x = x[keep]
+    return {
+        "n": int(x.shape[0]),
+        "sum": np.sum(x, axis=0),
+        "outer": x.T @ x,
+    }
+
+
+def _pearson_from_moments(n: float, s: np.ndarray, o: np.ndarray) -> np.ndarray:
+    """Correlation matrix from pooled (n, Σx, Σxxᵀ)."""
+    mean = s / n
+    cov = o / n - np.outer(mean, mean)
+    sd = np.sqrt(np.clip(np.diag(cov), 1e-30, None))
+    return cov / np.outer(sd, sd)
+
+
+@algorithm_client
+def central_correlation(
+    client: Any,
+    columns: list[str],
+    organizations: list[int] | None = None,
+) -> dict[str, Any]:
+    """Pooled Pearson correlation matrix — equals the matrix on the
+    concatenated rows (moments are additive)."""
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+    task = client.task.create(
+        input_={"method": "partial_moments", "kwargs": {"columns": columns}},
+        organizations=orgs,
+        name="correlation_partial",
+    )
+    parts = client.wait_for_results(
+        task_id=task["id"] if isinstance(task, dict) else task.id
+    )
+    n = float(sum(p["n"] for p in parts))
+    if n < 2:
+        raise ValueError("fewer than 2 complete rows across the federation")
+    s = np.sum([np.asarray(p["sum"]) for p in parts], axis=0)
+    o = np.sum([np.asarray(p["outer"]) for p in parts], axis=0)
+    corr = _pearson_from_moments(n, s, o)
+    return {
+        "columns": columns,
+        "matrix": [[float(v) for v in row] for row in corr],
+        "n": int(n),
+    }
+
+
+# ------------------------------------------------------ correlation (device)
+def correlation_device(
+    mesh: FederationMesh,
+    sx: jax.Array,  # [S, n_max, p] rows (pad with zeros)
+    row_mask: jax.Array,  # [S, n_max] 1.0 for real rows
+) -> jax.Array:
+    """Every station's moment block in ONE SPMD program, one all-reduce,
+    correlation computed on device. Returns the [p, p] matrix."""
+
+    def station_block(x, m):
+        xm = x * m[:, None]
+        return jnp.sum(m), jnp.sum(xm, axis=0), xm.T @ xm
+
+    n, s, o = mesh.fed_map(station_block, sx, row_mask)
+    n = fed_sum(n)
+    s = fed_sum(s)
+    o = fed_sum(o)
+    mean = s / n
+    cov = o / n - jnp.outer(mean, mean)
+    sd = jnp.sqrt(jnp.clip(jnp.diag(cov), 1e-30))
+    return cov / jnp.outer(sd, sd)
