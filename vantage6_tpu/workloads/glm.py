@@ -79,8 +79,7 @@ def _irls_pieces(family: str, eta, y, weights):
     return mu, z, w, dev
 
 
-def _xlogy(x, y):
-    return jnp.where(x == 0.0, 0.0, x * jnp.log(jnp.clip(y, 1e-30)))
+from jax.scipy.special import xlogy as _xlogy  # 0 where x == 0
 
 
 def _design(df: Any, feature_cols: list[str]) -> np.ndarray:
@@ -171,7 +170,7 @@ def central_glm(
             organizations=orgs,
             name=f"glm_irls_{it}",
         )
-        parts = client.wait_for_results(task_id=task["id"] if isinstance(task, dict) else task.id)
+        parts = client.wait_for_results(task_id=task["id"])
         xtwx = np.sum([np.asarray(r["xtwx"]) for r in parts], axis=0)
         xtwz = np.sum([np.asarray(r["xtwz"]) for r in parts], axis=0)
         deviance = float(np.sum([r["deviance"] for r in parts]))
@@ -203,6 +202,44 @@ def central_glm(
 
 
 # --------------------------------------------------------------- device mode
+import functools
+
+
+@functools.cache
+def _glm_runner(mesh: FederationMesh, family: str, n_iter: int):
+    """Compiled IRLS runner, cached per (mesh, family, n_iter): repeated
+    fits with same-shaped data reuse one executable instead of paying XLA
+    compilation of the whole scan every call. Data enters as ARGUMENTS, not
+    trace constants."""
+
+    def station_stats(x, y, m, beta):
+        eta = x @ beta
+        _, z, w, dev = _irls_pieces(family, eta, y, m)
+        # row mask rides the IRLS weight: padded rows contribute zero
+        xw = x * w[:, None]
+        return x.T @ xw, xw.T @ z, jnp.sum(dev)
+
+    def run(beta0, sx, sy, row_mask):
+        p = sx.shape[-1]
+
+        def one_iter(beta, _):
+            xtwx, xtwz, dev = mesh.fed_map(
+                station_stats, sx, sy, row_mask, replicated_args=(beta,)
+            )
+            xtwx = fed_sum(xtwx)
+            xtwz = fed_sum(xtwz)
+            dev = fed_sum(dev)
+            new_beta = jnp.linalg.solve(
+                xtwx + _JITTER * jnp.eye(p, dtype=xtwx.dtype), xtwz
+            )
+            delta = jnp.max(jnp.abs(new_beta - beta))
+            return new_beta, (delta, dev)
+
+        return jax.lax.scan(one_iter, beta0, None, length=n_iter)
+
+    return jax.jit(run)
+
+
 def fit_glm_device(
     mesh: FederationMesh,
     sx: jax.Array,  # [S, n_max, p] designs (pad rows with zeros)
@@ -221,51 +258,28 @@ def fit_glm_device(
     read off the returned delta history, not data-dependent control flow.
     """
     _check_family(family)
-    p = sx.shape[-1]
-
-    def station_stats(x, y, m, beta):
-        eta = x @ beta
-        _, z, w, dev = _irls_pieces(family, eta, y, m)
-        # row mask rides the IRLS weight: padded rows contribute zero
-        xw = x * w[:, None]
-        return x.T @ xw, xw.T @ z, jnp.sum(dev)
-
-    def one_iter(beta, _):
-        xtwx, xtwz, dev = mesh.fed_map(
-            station_stats, sx, sy, row_mask, replicated_args=(beta,)
-        )
-        xtwx = fed_sum(xtwx)
-        xtwz = fed_sum(xtwz)
-        dev = fed_sum(dev)
-        new_beta = jnp.linalg.solve(
-            xtwx + _JITTER * jnp.eye(p, dtype=xtwx.dtype), xtwz
-        )
-        delta = jnp.max(jnp.abs(new_beta - beta))
-        return new_beta, (delta, dev)
-
-    @jax.jit
-    def run(beta0):
-        return jax.lax.scan(one_iter, beta0, None, length=n_iter)
-
-    beta0 = jnp.zeros((p,), sx.dtype)
-    beta, (deltas, devs) = run(beta0)
+    beta0 = jnp.zeros((sx.shape[-1],), sx.dtype)
+    beta, (deltas, devs) = _glm_runner(mesh, family, n_iter)(
+        beta0, sx, sy, row_mask
+    )
     return {"beta": beta, "deltas": deltas, "deviances": devs}
 
 
 def stack_glm_data(
     frames: list[Any], feature_cols: list[str], label_col: str
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Per-station DataFrames -> padded stacked (designs, labels, row mask)."""
-    xs = [_design(f, feature_cols) for f in frames]
-    ys = [np.asarray(f[label_col], np.float64) for f in frames]
-    n_max = max(x.shape[0] for x in xs)
-    p = xs[0].shape[1]
-    S = len(frames)
-    sx = np.zeros((S, n_max, p))
-    sy = np.zeros((S, n_max))
-    m = np.zeros((S, n_max))
-    for i, (x, y) in enumerate(zip(xs, ys)):
-        sx[i, : x.shape[0]] = x
-        sy[i, : y.shape[0]] = y
-        m[i, : x.shape[0]] = 1.0
-    return sx, sy, m
+    """Per-station DataFrames -> padded stacked (designs, labels, row mask).
+
+    Padding delegates to utils.datasets.pad_shards — the single home of the
+    SPMD static-shape padding invariant.
+    """
+    from vantage6_tpu.utils.datasets import pad_shards
+
+    shards = [
+        (_design(f, feature_cols), np.asarray(f[label_col], np.float64))
+        for f in frames
+    ]
+    sx, sy, counts = pad_shards(shards)
+    n_max = sx.shape[1]
+    mask = (np.arange(n_max)[None, :] < counts[:, None]).astype(np.float64)
+    return sx, sy, mask

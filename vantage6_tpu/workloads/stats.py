@@ -79,9 +79,7 @@ def central_crosstab(
         organizations=orgs,
         name="crosstab_partial",
     )
-    parts = client.wait_for_results(
-        task_id=task["id"] if isinstance(task, dict) else task.id
-    )
+    parts = client.wait_for_results(task_id=task["id"])
     total: dict[tuple[str, str], int | None] = {}
     for part in parts:
         for r, c, n in part["cells"]:
@@ -137,9 +135,7 @@ def central_correlation(
         organizations=orgs,
         name="correlation_partial",
     )
-    parts = client.wait_for_results(
-        task_id=task["id"] if isinstance(task, dict) else task.id
-    )
+    parts = client.wait_for_results(task_id=task["id"])
     n = float(sum(p["n"] for p in parts))
     if n < 2:
         raise ValueError("fewer than 2 complete rows across the federation")
