@@ -256,10 +256,34 @@ class TestServerStoreProxy:
             # auth required on the proxy
             anon = srv.test_client()
             assert anon.get("/api/store/algorithm").status == 401
+            # researcher SDK surface over real sockets
+            http = srv.serve(port=0, background=True)
+            try:
+                uc = UserClient(http.url)
+                uc.authenticate("root", "rootpass123")
+                assert uc.store.info()["url"] == shttp.url
+                assert [a["name"] for a in uc.store.algorithms()] == ["km"]
+            finally:
+                http.stop()
         finally:
             srv.close()
             shttp.stop()
             store.close()
+
+    def test_sdk_store_unlinked(self):
+        srv = ServerApp()
+        try:
+            srv.ensure_root(password="rootpass123")
+            http = srv.serve(port=0, background=True)
+            try:
+                uc = UserClient(http.url)
+                uc.authenticate("root", "rootpass123")
+                assert uc.store.info()["url"] is None
+                assert uc.store.algorithms() == []  # 404 -> empty, no raise
+            finally:
+                http.stop()
+        finally:
+            srv.close()
 
     def test_no_store_linked_404(self):
         srv = ServerApp()

@@ -59,6 +59,7 @@ class UserClient:
         self.rule = SubClient(self, "rule")
         self.study = SubClient(self, "study")
         self.session = SessionSubClient(self)
+        self.store = StoreSubClient(self)
         self.util = UtilSubClient(self)
 
     # ------------------------------------------------------------------ http
@@ -289,6 +290,33 @@ class RunSubClient(SubClient):
 
     def from_task(self, task_id: int) -> list[dict[str, Any]]:
         return self.parent.paginate(f"task/{task_id}/run")
+
+
+class StoreSubClient:
+    """Browse the algorithm store LINKED to this server (reference: the
+    UserClient's store surface): the server proxies the store's public
+    listing, so researchers discover approved algorithms — including full
+    function/argument metadata, the same payload the web UI's task wizard
+    consumes — without talking to the store directly."""
+
+    def __init__(self, parent: "UserClient"):
+        self.parent = parent
+
+    def info(self) -> dict[str, Any]:
+        """{"url": <store url or None>} — whether a store is linked."""
+        return self.parent.request("GET", "store")
+
+    def algorithms(self) -> list[dict[str, Any]]:
+        """Approved algorithms with functions/arguments metadata; empty
+        when no store is linked (the server 404s that case itself)."""
+        try:
+            return self.parent.request(
+                "GET", "store/algorithm"
+            ).get("data", [])
+        except ClientError as e:
+            if e.status == 404:
+                return []
+            raise
 
 
 class UtilSubClient:
