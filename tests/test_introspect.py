@@ -1,0 +1,137 @@
+"""store.introspect: algorithm-module metadata derived from the decorators
+must match what a developer would hand-write for the store — and round-trip
+through submit→review→approve into the shape the UI wizard consumes."""
+import numpy as np
+import pytest
+
+from vantage6_tpu.store.introspect import build_algorithm_spec
+
+
+class TestSpecDerivation:
+    def test_average_module(self):
+        spec = build_algorithm_spec(
+            "vantage6_tpu.workloads.average",
+            name="federated average", image="v6-average-py",
+        )
+        assert spec["image"] == "v6-average-py"
+        fns = {f["name"]: f for f in spec["functions"]}
+        central = fns["central_average"]
+        assert central["type"] == "central"
+        args = {a["name"]: a for a in central["arguments"]}
+        assert args["column"]["type"] == "column"
+        assert args["column"]["has_default"] is False
+        assert args["organizations"]["type"] == "organization_list"
+        partial = fns["partial_average"]
+        assert partial["type"] == "federated"
+        assert partial["databases"] == [{"name": "default"}]
+        # injected args (df / client) never leak into the spec
+        assert "df" not in {a["name"] for a in partial["arguments"]}
+
+    def test_glm_module_types(self):
+        spec = build_algorithm_spec(
+            "vantage6_tpu.workloads.glm", name="glm", image="v6-glm-py"
+        )
+        central = next(
+            f for f in spec["functions"] if f["name"] == "central_glm"
+        )
+        args = {a["name"]: a for a in central["arguments"]}
+        assert args["family"]["type"] == "string"
+        assert args["feature_cols"]["type"] in ("json", "column")
+        assert args["n_iter"]["type"] == "integer"
+        assert args["n_iter"]["default"] == 25
+        assert args["tol"]["type"] == "float"
+
+    def test_module_without_entry_points_rejected(self):
+        with pytest.raises(ValueError, match="no @data/@algorithm_client"):
+            build_algorithm_spec(
+                "vantage6_tpu.common.shamir", name="x", image="y"
+            )
+
+    def test_stacked_decorators_and_missing_docstrings(self):
+        # @data(2) + @algorithm_client: BOTH injected arg groups must be
+        # stripped, the function is central AND declares its databases
+        import types
+
+        from vantage6_tpu.algorithm.decorators import algorithm_client, data
+
+        mod = types.ModuleType("no_doc_algo")  # no module docstring
+
+        @algorithm_client
+        @data(2)
+        def combo(client, df1, df2, column: str, k: int = 3):
+            """Central step that also reads two local frames."""
+            return None
+
+        mod.combo = combo
+        spec = build_algorithm_spec(mod, name="combo", image="combo:1")
+        assert spec["description"] == ""  # docstring-less module: no crash
+        fn = spec["functions"][0]
+        assert fn["type"] == "central"
+        assert fn["databases"] == [{"name": "default"}, {"name": "db1"}]
+        names = [a["name"] for a in fn["arguments"]]
+        assert names == ["column", "k"]  # df1/df2/client never leak
+
+
+class TestStoreRoundTrip:
+    def test_derived_spec_survives_submit_review_approve(self):
+        from vantage6_tpu.client import UserClient
+        from vantage6_tpu.server.app import ServerApp
+        from vantage6_tpu.store.app import StoreApp
+
+        srv = ServerApp()
+        srv.ensure_root(password="rootpass123")
+        http = srv.serve(port=0, background=True)
+        store = StoreApp(reviewers=["rev"], trusted_servers=[http.url])
+        try:
+            c = UserClient(http.url)
+            c.authenticate("root", "rootpass123")
+            org = c.organization.create(name="intro_org")
+            researcher = next(
+                r for r in c.role.list() if r["name"] == "Researcher"
+            )
+            for u in ("dev", "rev"):  # a reviewer must not self-review
+                c.user.create(
+                    username=u, password=f"{u}pass12345",
+                    organization_id=org["id"], roles=[researcher["id"]],
+                )
+            dev = UserClient(http.url)
+            dev.authenticate("dev", "devpass12345")
+            rev_c = UserClient(http.url)
+            rev_c.authenticate("rev", "revpass12345")
+            spec = build_algorithm_spec(
+                "vantage6_tpu.workloads.stats",
+                name="descriptive stats", image="v6-crosstab-py",
+            )
+            sc = store.test_client()
+            alg = sc.open(
+                "POST", "/api/algorithm", spec,
+                headers={"Server-Url": http.url}, token=dev._access_token,
+            )
+            assert alg.status == 201, alg.json
+            rev = sc.open(
+                "POST", f"/api/algorithm/{alg.json['id']}/review", None,
+                headers={"Server-Url": http.url}, token=rev_c._access_token,
+            )
+            assert rev.status == 201, rev.json
+            done = sc.open(
+                "PATCH", f"/api/review/{rev.json['id']}",
+                {"status": "approved"},
+                headers={"Server-Url": http.url}, token=rev_c._access_token,
+            )
+            assert done.status == 200, done.json
+            # public listing carries the derived wizard metadata
+            pub = sc.get("/api/algorithm").json["data"]
+            got = next(a for a in pub if a["image"] == "v6-crosstab-py")
+            fn = next(
+                f for f in got["functions"]
+                if f["name"] == "central_crosstab"
+            )
+            args = {a["name"]: a for a in fn["arguments"]}
+            assert args["row_col"]["type"] == "column"
+            assert args["row_col"]["has_default"] is False  # required
+            assert args["min_cell_count"]["type"] == "integer"
+            assert args["min_cell_count"]["default"] == 0
+        finally:
+            store.close()
+            http.stop()
+            srv.close()

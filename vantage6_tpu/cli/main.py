@@ -693,6 +693,28 @@ def algorithm() -> None:
     """Algorithm development helpers."""
 
 
+@algorithm.command("describe")
+@click.argument("module")
+@click.option("--name", default=None, help="algorithm display name")
+@click.option("--image", default=None, help="image the nodes resolve")
+def algorithm_describe(module: str, name: str | None, image: str | None) -> None:
+    """Introspect a module's decorated functions into store metadata.
+
+    Prints the JSON payload for the store's POST /api/algorithm — every
+    @data/@algorithm_client function becomes a Function row with typed
+    Arguments, so the web UI task wizard can render a guided form for it.
+    """
+    import json as _json
+
+    from vantage6_tpu.store.introspect import build_algorithm_spec
+
+    spec = build_algorithm_spec(
+        module, name=name or module.rsplit(".", 1)[-1],
+        image=image or module.rsplit(".", 1)[-1],
+    )
+    click.echo(_json.dumps(spec, indent=2, default=str))
+
+
 @algorithm.command("create")
 @click.option("--name", prompt=True, help="package name, e.g. my-average")
 @click.option("--directory", type=click.Path(), default=".", show_default=True)

@@ -200,7 +200,11 @@ class StoreApp:
                         display_name=arg.get("display_name", arg.get("name", "")),
                         description=arg.get("description", ""),
                         type=arg.get("type", "string"),
-                        has_default="default" in arg,
+                        # explicit has_default wins (a default of null is a
+                        # real default; absence of one is not)
+                        has_default=bool(
+                            arg.get("has_default", "default" in arg)
+                        ),
                         default=arg.get("default"),
                     ).save()
             return alg.to_dict(), 201

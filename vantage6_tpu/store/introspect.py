@@ -1,0 +1,173 @@
+"""Algorithm-module introspection → store metadata.
+
+The reference's algorithm store holds per-function signatures (name, type,
+arguments with types/defaults) that power the UI's task wizard; developers
+there fill them in by hand in `algorithm_store.json`. Here the decorators
+already carry everything needed, so the spec is DERIVED from the module:
+
+- `@algorithm_client` functions → type "central";
+- `@data(n)` functions → type "federated" with n database slots;
+- argument names/annotations/defaults → store `Argument` rows (type
+  inferred from the annotation; a parameter ANNOTATED ``str`` whose name
+  ends in ``_col``/``_cols``/``column``/``columns`` maps to the wizard's
+  "column" type).
+
+Used by `v6t algorithm describe` (prints the JSON to submit) and directly
+by `StoreApp` clients; a spec produced here round-trips through the
+submit→review→approve flow and feeds the web UI wizard unchanged.
+"""
+from __future__ import annotations
+
+import inspect
+import types
+from typing import Any, Callable
+
+_COLUMNISH = ("column", "columns")
+
+
+def _argument_type(name: str, annotation: Any, default: Any) -> str:
+    """Map a python signature entry to a store Argument.TYPES value."""
+    ann = annotation
+    if isinstance(ann, str):  # from __future__ annotations: unresolved text
+        ann = ann.replace(" ", "")
+        if ann.startswith(("list", "dict")):
+            return "json"
+        if ann.startswith("int"):
+            return "integer"
+        if ann.startswith("float"):
+            return "float"
+        if ann.startswith("bool"):
+            return "boolean"
+        if ann.startswith("str"):
+            return _string_or_column(name)
+    elif ann in (int,):
+        return "integer"
+    elif ann in (float,):
+        return "float"
+    elif ann in (bool,):
+        return "boolean"
+    elif ann in (str,):
+        return _string_or_column(name)
+    elif ann in (list, dict) or getattr(ann, "__origin__", None) in (
+        list,
+        dict,
+    ):
+        return "json"
+    # no/unknown annotation: infer from the default value
+    if isinstance(default, bool):
+        return "boolean"
+    if isinstance(default, int):
+        return "integer"
+    if isinstance(default, float):
+        return "float"
+    if isinstance(default, (list, dict)):
+        return "json"
+    if isinstance(default, str):
+        return _string_or_column(name)
+    return _string_or_column(name)
+
+
+def _string_or_column(name: str) -> str:
+    base = name.lower()
+    if base.endswith(("_col", "_cols")) or any(
+        base == c or base.endswith("_" + c) or base.startswith(c)
+        for c in _COLUMNISH
+    ):
+        return "column"
+    return "string"
+
+
+def _function_spec(name: str, fn: Callable) -> dict[str, Any] | None:
+    """One store Function row from a decorated callable, or None when the
+    callable is not an algorithm entry point."""
+    n_dataframes = getattr(fn, "__v6t_n_dataframes__", None)
+    needs_client = getattr(fn, "__v6t_needs_client__", False)
+    if n_dataframes is None and not needs_client:
+        return None
+    sig = inspect.signature(getattr(fn, "plain", fn))
+    params = list(sig.parameters.values())
+    # strip ALL injected leading args: a function may stack @data(n) with
+    # @algorithm_client (client first, then the dataframes)
+    skip = (1 if needs_client else 0) + int(n_dataframes or 0)
+    params = params[skip:]
+    arguments = []
+    for p in params:
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if p.name == "organizations":
+            arguments.append({
+                "name": p.name,
+                "type": "organization_list",
+                "has_default": p.default is not inspect.Parameter.empty,
+                "default": None,
+            })
+            continue
+        has_default = p.default is not inspect.Parameter.empty
+        default = p.default if has_default else None
+        arg: dict[str, Any] = {
+            "name": p.name,
+            "type": _argument_type(p.name, p.annotation, default),
+            "has_default": has_default,
+            "description": "",
+        }
+        if has_default:
+            arg["default"] = default
+        arguments.append(arg)
+    doc = (inspect.getdoc(fn) or "").strip().splitlines()
+    spec: dict[str, Any] = {
+        "name": name,
+        "display_name": name.replace("_", " "),
+        "description": doc[0] if doc else "",
+        # a client-needing function is the orchestrating (central) step even
+        # when it also reads local data; pure @data functions are federated
+        "type": "central" if needs_client else "federated",
+        "arguments": arguments,
+    }
+    if n_dataframes:
+        spec["databases"] = [
+            {"name": f"db{i}" if i else "default"}
+            for i in range(int(n_dataframes))
+        ]
+    return spec
+
+
+def build_algorithm_spec(
+    module: types.ModuleType | str,
+    name: str,
+    image: str,
+    description: str = "",
+    partitioning: str = "horizontal",
+) -> dict[str, Any]:
+    """The full store submission payload for an algorithm module.
+
+    Every `@algorithm_client` / `@data` function becomes a Function row
+    with typed Arguments — the exact shape `StoreApp`'s POST /api/algorithm
+    accepts and the web UI's task wizard renders.
+    """
+    if isinstance(module, str):
+        import importlib
+
+        module = importlib.import_module(module)
+    functions = []
+    for attr_name in sorted(vars(module)):
+        if attr_name.startswith("_"):
+            continue
+        fn = getattr(module, attr_name)
+        if not callable(fn):
+            continue
+        spec = _function_spec(attr_name, fn)
+        if spec is not None:
+            functions.append(spec)
+    if not functions:
+        raise ValueError(
+            f"module {module.__name__!r} exposes no @data/@algorithm_client "
+            "functions — nothing to register"
+        )
+    mod_doc = (inspect.getdoc(module) or "").strip().splitlines()
+    return {
+        "name": name,
+        "image": image,
+        "description": description or (mod_doc[0] if mod_doc else ""),
+        "partitioning": partitioning,
+        "functions": functions,
+    }
