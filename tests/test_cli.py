@@ -111,6 +111,35 @@ class TestDev:
 
         df = pd.read_csv(ctx.databases[0]["uri"])
         assert {"age", "weight", "event", "time"} <= set(df.columns)
+        # the demo store exists, is linked from the server config, and is
+        # SEEDED with approved introspected builtin algorithms so the web
+        # UI's task wizard works out of the box
+        from vantage6_tpu.common.context import StoreContext
+
+        assert StoreContext.config_exists("d1_store")
+        store_ctx = StoreContext("d1_store")
+        server_ctx = ServerContext("d1_server")
+        assert server_ctx.config["store_url"] == (
+            f"http://127.0.0.1:{store_ctx.port}"
+        )
+        from vantage6_tpu.store.app import StoreApp
+
+        app = StoreApp(uri=store_ctx.uri)
+        try:
+            listing = app.test_client().get("/api/algorithm").json["data"]
+        finally:
+            app.close()
+        images = {a["image"] for a in listing}
+        assert "v6-average-py" in images and "v6-glm-py" in images
+        avg = next(a for a in listing if a["image"] == "v6-average-py")
+        assert all(a["status"] == "approved" for a in listing)
+        central = next(
+            f for f in avg["functions"] if f["name"] == "central_average"
+        )
+        assert any(
+            arg["name"] == "column" and arg["type"] == "column"
+            for arg in central["arguments"]
+        )
         # duplicate creation refused
         r = runner.invoke(
             cli, ["dev", "create-demo-network", "--name", "d1", "-n", "2"]
@@ -128,6 +157,9 @@ class TestDev:
             n.startswith("d2_node_")
             for n in NodeContext.available_configurations()
         )
+        from vantage6_tpu.common.context import StoreContext
+
+        assert not StoreContext.config_exists("d2_store")
 
 
 class TestAlgorithmCreate:

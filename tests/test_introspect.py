@@ -52,7 +52,11 @@ class TestSpecDerivation:
         # stripped, the function is central AND declares its databases
         import types
 
-        from vantage6_tpu.algorithm.decorators import algorithm_client, data
+        from vantage6_tpu.algorithm.decorators import (
+            algorithm_client,
+            data,
+            metadata,
+        )
 
         mod = types.ModuleType("no_doc_algo")  # no module docstring
 
@@ -63,13 +67,25 @@ class TestSpecDerivation:
             return None
 
         mod.combo = combo
+
+        @metadata
+        @data(1)
+        def with_meta(meta, df, column: str):
+            """Partial that also reads run metadata."""
+            return None
+
+        mod.with_meta = with_meta
         spec = build_algorithm_spec(mod, name="combo", image="combo:1")
         assert spec["description"] == ""  # docstring-less module: no crash
-        fn = spec["functions"][0]
+        fns = {f["name"]: f for f in spec["functions"]}
+        fn = fns["combo"]
         assert fn["type"] == "central"
         assert fn["databases"] == [{"name": "default"}, {"name": "db1"}]
         names = [a["name"] for a in fn["arguments"]]
         assert names == ["column", "k"]  # df1/df2/client never leak
+        # @metadata + @data: the injected meta AND df are both stripped
+        meta_fn = fns["with_meta"]
+        assert [a["name"] for a in meta_fn["arguments"]] == ["column"]
 
 
 class TestStoreRoundTrip:

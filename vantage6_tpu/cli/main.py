@@ -503,10 +503,35 @@ def dev_create(name: str, num_nodes: int, directory: str | None) -> None:
     import numpy as np
     import pandas as pd
 
-    if ServerContext.config_exists(f"{name}_server"):
-        raise click.ClickException(f"demo network {name!r} already exists")
+    if ServerContext.config_exists(f"{name}_server") or (
+        StoreContext.config_exists(f"{name}_store")
+    ):
+        raise click.ClickException(
+            f"demo network {name!r} already exists (fully or partially) — "
+            f"run `v6t dev remove-demo-network --name {name}` first"
+        )
+    # the demo gets its own algorithm store, pre-seeded with the builtin
+    # algorithms' INTROSPECTED metadata (store.introspect) and linked to
+    # the server — the web UI's task wizard works out of the box.
+    # server_port is THE single source for every URL below (store trust,
+    # node api_url, login hint).
+    server_port = ServerContext.DEFAULT_PORT
+    api_url = f"http://127.0.0.1:{server_port}"
+    store_ctx = StoreContext.create(
+        f"{name}_store",
+        {
+            "port": StoreContext.DEFAULT_PORT,
+            "trusted_servers": [api_url],
+            "open_review": True,
+        },
+    )
+    _seed_demo_store(store_ctx)
     server_ctx = ServerContext.create(
-        f"{name}_server", {"port": ServerContext.DEFAULT_PORT}
+        f"{name}_server",
+        {
+            "port": server_port,
+            "store_url": f"http://127.0.0.1:{store_ctx.port}",
+        },
     )
     data_dir = Path(directory) if directory else server_ctx.data_dir / "demo_data"
     data_dir.mkdir(parents=True, exist_ok=True)
@@ -549,7 +574,6 @@ def dev_create(name: str, num_nodes: int, directory: str | None) -> None:
         summary = _import_entities(app, entities)
     finally:
         app.close()
-    api_url = f"http://127.0.0.1:{server_ctx.port}"
     for (org, csv), node_info in zip(node_names, summary["nodes"]):
         NodeContext.create(
             f"{name}_node_{org.removeprefix(name + '_org_')}",
@@ -564,15 +588,50 @@ def dev_create(name: str, num_nodes: int, directory: str | None) -> None:
             },
         )
     click.echo(
-        f"demo network {name!r}: 1 server + {num_nodes} nodes configured\n"
+        f"demo network {name!r}: 1 server + 1 store + {num_nodes} nodes "
+        "configured\n"
         f"  start:  v6t dev start-demo-network --name {name}\n"
         f"  login:  dev_admin / password123 at {api_url}"
     )
 
 
+# demo-store wizard set: builtin task-round algorithms whose metadata the
+# web UI renders as guided forms (image -> module, from BUILTIN_ALGORITHMS)
+DEMO_STORE_IMAGES = (
+    "v6-average-py",
+    "v6-summary-py",
+    "v6-logistic-regression-py",
+    "v6-kaplan-meier-py",
+    "v6-glm-py",
+    "v6-crosstab-py",
+)
+
+
+def _seed_demo_store(store_ctx: "StoreContext") -> None:
+    """Fill a fresh demo store with the builtins' introspected metadata,
+    pre-approved (demo only; real deployments approve through reviews)."""
+    from vantage6_tpu.store.app import StoreApp
+    from vantage6_tpu.store.introspect import build_algorithm_spec
+
+    app = StoreApp(uri=store_ctx.uri, open_review=True)
+    try:
+        for image in DEMO_STORE_IMAGES:
+            spec = build_algorithm_spec(
+                BUILTIN_ALGORITHMS[image], name=image, image=image
+            )
+            app.insert_algorithm(
+                spec, submitted_by="demo-seed", status="approved"
+            )
+    finally:
+        app.close()
+
+
 @dev.command("start-demo-network")
 @click.option("--name", default="demo", show_default=True)
 def dev_start(name: str) -> None:
+    if StoreContext.config_exists(f"{name}_store"):
+        pid = _start_detached(StoreContext(f"{name}_store"), "_run-store")
+        click.echo(f"store up (pid {pid})")
     server_ctx = ServerContext(f"{name}_server")
     pid = _start_detached(server_ctx, "_run-server")
     click.echo(f"server up (pid {pid})")
@@ -610,6 +669,9 @@ def dev_stop(name: str) -> None:
     if ServerContext.config_exists(f"{name}_server"):
         _stop_instance(ServerContext(f"{name}_server"))
         click.echo("server stopped")
+    if StoreContext.config_exists(f"{name}_store"):
+        _stop_instance(StoreContext(f"{name}_store"))
+        click.echo("store stopped")
 
 
 @dev.command("remove-demo-network")
@@ -625,6 +687,11 @@ def dev_remove(name: str) -> None:
             ctx.config_path.unlink(missing_ok=True)
     if ServerContext.config_exists(f"{name}_server"):
         ctx = ServerContext(f"{name}_server")
+        _stop_instance(ctx)
+        shutil.rmtree(ctx.data_dir, ignore_errors=True)
+        ctx.config_path.unlink(missing_ok=True)
+    if StoreContext.config_exists(f"{name}_store"):
+        ctx = StoreContext(f"{name}_store")
         _stop_instance(ctx)
         shutil.rmtree(ctx.data_dir, ignore_errors=True)
         ctx.config_path.unlink(missing_ok=True)

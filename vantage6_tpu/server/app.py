@@ -123,6 +123,7 @@ def run_server(ctx: ServerContext, background: bool = False) -> AppServer:
         uri=ctx.uri,
         jwt_secret=ctx.config.get("jwt_secret") or None,
         mailer=mailer_from_config(ctx.config.get("smtp")),
+        store_url=ctx.config.get("store_url") or None,
     )
     user, generated = srv.ensure_root()
     if generated:

@@ -49,6 +49,58 @@ class StoreApp:
         self.db.close()
         sm.StoreModel.db = None
 
+    def insert_algorithm(
+        self,
+        spec: dict[str, Any],
+        submitted_by: str,
+        status: str = "submitted",
+    ) -> "sm.Algorithm":
+        """Persist one algorithm + its functions/arguments from a spec
+        (the POST /api/algorithm body shape; store.introspect produces it).
+
+        ``status`` is "submitted" on the wire path; demo seeding passes
+        "approved" to skip the review queue (dev networks only — a real
+        deployment approves through reviews).
+        """
+        alg = sm.Algorithm(
+            name=spec["name"],
+            image=spec["image"],
+            description=spec.get("description", ""),
+            partitioning=spec.get("partitioning", "horizontal"),
+            vantage6_version=spec.get("vantage6_version", ""),
+            code_url=spec.get("code_url", ""),
+            digest=spec.get("digest", ""),
+            status=status,
+            submitted_by=submitted_by,
+            approved_at=time.time() if status == "approved" else None,
+        ).save()
+        for fn in spec.get("functions", []) or []:
+            f = sm.Function(
+                algorithm_id=alg.id,
+                name=fn.get("name", ""),
+                display_name=fn.get("display_name", fn.get("name", "")),
+                description=fn.get("description", ""),
+                type=fn.get("type", "federated"),
+                databases=fn.get("databases", []) or [],
+            ).save()
+            for arg in fn.get("arguments", []) or []:
+                sm.Argument(
+                    function_id=f.id,
+                    name=arg.get("name", ""),
+                    display_name=arg.get(
+                        "display_name", arg.get("name", "")
+                    ),
+                    description=arg.get("description", ""),
+                    type=arg.get("type", "string"),
+                    # explicit has_default wins (a default of null is a
+                    # real default; absence of one is not)
+                    has_default=bool(
+                        arg.get("has_default", "default" in arg)
+                    ),
+                    default=arg.get("default"),
+                ).save()
+        return alg
+
     # ------------------------------------------------------------- trust
     def trust_server(self, url: str) -> None:
         url = url.rstrip("/")
@@ -173,40 +225,7 @@ class StoreApp:
                         raise HTTPError(
                             400, f"bad argument type {arg.get('type')}"
                         )
-            alg = sm.Algorithm(
-                name=body["name"],
-                image=body["image"],
-                description=body.get("description", ""),
-                partitioning=partitioning,
-                vantage6_version=body.get("vantage6_version", ""),
-                code_url=body.get("code_url", ""),
-                digest=body.get("digest", ""),
-                status="submitted",
-                submitted_by=who["username"],
-            ).save()
-            for fn in body.get("functions", []) or []:
-                f = sm.Function(
-                    algorithm_id=alg.id,
-                    name=fn.get("name", ""),
-                    display_name=fn.get("display_name", fn.get("name", "")),
-                    description=fn.get("description", ""),
-                    type=fn.get("type", "federated"),
-                    databases=fn.get("databases", []) or [],
-                ).save()
-                for arg in fn.get("arguments", []) or []:
-                    sm.Argument(
-                        function_id=f.id,
-                        name=arg.get("name", ""),
-                        display_name=arg.get("display_name", arg.get("name", "")),
-                        description=arg.get("description", ""),
-                        type=arg.get("type", "string"),
-                        # explicit has_default wins (a default of null is a
-                        # real default; absence of one is not)
-                        has_default=bool(
-                            arg.get("has_default", "default" in arg)
-                        ),
-                        default=arg.get("default"),
-                    ).save()
+            alg = self.insert_algorithm(body, submitted_by=who["username"])
             return alg.to_dict(), 201
 
         @app.route("/api/algorithm/<int:id>", methods=("GET", "DELETE"))

@@ -82,13 +82,19 @@ def _function_spec(name: str, fn: Callable) -> dict[str, Any] | None:
     callable is not an algorithm entry point."""
     n_dataframes = getattr(fn, "__v6t_n_dataframes__", None)
     needs_client = getattr(fn, "__v6t_needs_client__", False)
+    needs_metadata = getattr(fn, "__v6t_needs_metadata__", False)
     if n_dataframes is None and not needs_client:
         return None
     sig = inspect.signature(getattr(fn, "plain", fn))
     params = list(sig.parameters.values())
-    # strip ALL injected leading args: a function may stack @data(n) with
-    # @algorithm_client (client first, then the dataframes)
-    skip = (1 if needs_client else 0) + int(n_dataframes or 0)
+    # strip ALL injected leading args — the decorators may stack in any
+    # combination (client / metadata / n dataframes); the count is what
+    # matters, the injected ones are always leading
+    skip = (
+        (1 if needs_client else 0)
+        + (1 if needs_metadata else 0)
+        + int(n_dataframes or 0)
+    )
     params = params[skip:]
     arguments = []
     for p in params:
