@@ -1,0 +1,198 @@
+"""Preprocessing tasks (v4.7 task-type ladder): the JSON pipeline language
+plus the full session flow — extract → PREPROCESS (persisted at the node)
+→ compute on the derived dataframe."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from vantage6_tpu.workloads.preprocess import apply_pipeline
+
+
+class TestPipeline:
+    def _df(self):
+        return pd.DataFrame({
+            "age": [30.0, 45.0, 60.0, np.nan],
+            "weight_kg": [70.0, 80.0, 90.0, 100.0],
+            "height_m": [1.6, 1.8, 1.75, 1.7],
+        })
+
+    def test_ops_compose(self):
+        out = apply_pipeline(self._df(), [
+            {"op": "dropna", "columns": ["age"]},
+            {"op": "filter", "column": "age", "cmp": "ge", "value": 40},
+            {"op": "derive", "column": "bmi",
+             "expr": {"op": "div", "args": ["weight_kg", "height_m"]}},
+            {"op": "derive", "column": "bmi",
+             "expr": {"op": "div", "args": ["bmi", "height_m"]}},
+            {"op": "rename", "mapping": {"weight_kg": "weight"}},
+            {"op": "select", "columns": ["age", "weight", "bmi"]},
+            {"op": "clip", "column": "age", "upper": 50},
+        ])
+        assert list(out.columns) == ["age", "weight", "bmi"]
+        assert out["age"].tolist() == [45.0, 50.0]
+        np.testing.assert_allclose(
+            out["bmi"], [80 / 1.8**2, 90 / 1.75**2]
+        )
+
+    def test_astype_and_literals(self):
+        out = apply_pipeline(self._df(), [
+            {"op": "derive", "column": "age2",
+             "expr": {"op": "mul", "args": ["age", 2]}},
+            {"op": "dropna", "columns": ["age2"]},
+            {"op": "astype", "column": "age2", "dtype": "int"},
+        ])
+        assert out["age2"].tolist() == [60, 90, 120]
+
+    @pytest.mark.parametrize("steps,msg", [
+        ([{"op": "teleport"}], "unknown op"),
+        ([{"op": "select", "columns": ["nope"]}], "unknown columns"),
+        ([{"op": "filter", "column": "age", "cmp": "??", "value": 1}],
+         "unknown cmp"),
+        ([{"op": "derive", "column": "x",
+           "expr": {"op": "add", "args": ["age", True]}}], "operand"),
+        ([{"op": "filter", "column": "age"}], "missing field"),
+        # a typo'd COLUMN must say so, not claim a step field is missing
+        ([{"op": "filter", "column": "agee", "cmp": "ge", "value": 1}],
+         "unknown columns"),
+        ([{"op": "clip", "column": "agee", "upper": 1}], "unknown columns"),
+        ([{"op": "dropna", "columns": ["agee"]}], "unknown columns"),
+        ([{"op": "astype", "column": "agee", "dtype": "int"}],
+         "unknown columns"),
+    ])
+    def test_bad_pipelines_fail_loudly(self, steps, msg):
+        with pytest.raises(ValueError, match=msg):
+            apply_pipeline(self._df(), steps)
+
+    def test_all_nan_column_summary_is_json_safe(self):
+        import json
+
+        from vantage6_tpu.workloads.preprocess import column_summary
+
+        df = pd.DataFrame({"x": [np.nan, np.nan]})
+        out = column_summary.plain(df)
+        assert out["x"]["mean"] is None  # not NaN: strict JSON must parse
+        json.loads(json.dumps(out, allow_nan=False))
+
+    def test_no_code_execution_surface(self):
+        # the language is data-only: strings are column names, never code
+        with pytest.raises(ValueError):
+            apply_pipeline(self._df(), [
+                {"op": "derive", "column": "x",
+                 "expr": {"op": "add",
+                          "args": ["__import__('os').system('id')", 1]}},
+            ])
+
+
+class TestSessionFlow:
+    def test_extract_preprocess_compute(self, tmp_path):
+        """The v4.7 ladder through real server+nodes: the preprocessing
+        task reads one session dataframe and persists another; compute
+        runs on the derived frame; raw rows never travel."""
+        import secrets as pysecrets
+
+        from vantage6_tpu.client import UserClient
+        from vantage6_tpu.node.daemon import NodeDaemon
+        from vantage6_tpu.server.app import ServerApp
+
+        rng = np.random.default_rng(3)
+        frames = []
+        for i in range(2):
+            df = pd.DataFrame({
+                "age": rng.uniform(10, 90, 60).round(1),
+                "weight_kg": rng.uniform(50, 110, 60).round(1),
+                "height_m": rng.uniform(1.5, 2.0, 60).round(2),
+            })
+            df.to_csv(tmp_path / f"s{i}.csv", index=False)
+            frames.append(df)
+
+        srv = ServerApp()
+        srv.ensure_root(password="rootpass123")
+        http = srv.serve(port=0, background=True)
+        daemons = []
+        try:
+            c = UserClient(http.url)
+            c.authenticate("root", "rootpass123")
+            orgs = [
+                c.organization.create(name=f"pp{i}") for i in range(2)
+            ]
+            collab = c.collaboration.create(
+                name="pp", organization_ids=[o["id"] for o in orgs]
+            )
+            for i, org in enumerate(orgs):
+                info = c.node.create(
+                    organization_id=org["id"],
+                    collaboration_id=collab["id"],
+                )
+                d = NodeDaemon(
+                    api_url=http.url, api_key=info["api_key"],
+                    algorithms={
+                        "v6-preprocess-py":
+                            "vantage6_tpu.workloads.preprocess",
+                        "v6-average-py": "vantage6_tpu.workloads.average",
+                    },
+                    databases=[{"label": "default", "type": "csv",
+                                "uri": str(tmp_path / f"s{i}.csv")}],
+                    mode="inline", poll_interval=0.05,
+                    station_secret=pysecrets.token_hex(32),
+                )
+                d.start()
+                daemons.append(d)
+
+            session = c.session.create(
+                name="ladder", collaboration_id=collab["id"]
+            )
+            all_orgs = [o["id"] for o in orgs]
+            # 1) EXTRACT: source db -> session dataframe "adults"
+            t1 = c.task.create(
+                collaboration=collab["id"], organizations=all_orgs,
+                image="v6-preprocess-py", session=session["id"],
+                store_as="adults",
+                input_={"method": "preprocess", "kwargs": {"steps": [
+                    {"op": "filter", "column": "age", "cmp": "ge",
+                     "value": 18},
+                ]}},
+            )
+            c.wait_for_results(t1["id"], timeout=60)
+            # 2) PREPROCESS: "adults" -> derived dataframe "with_bmi"
+            t2 = c.task.create(
+                collaboration=collab["id"], organizations=all_orgs,
+                image="v6-preprocess-py", session=session["id"],
+                store_as="with_bmi",
+                databases=[{"label": "d", "type": "session",
+                            "dataframe": "adults"}],
+                input_={"method": "preprocess", "kwargs": {"steps": [
+                    {"op": "derive", "column": "bmi",
+                     "expr": {"op": "div",
+                              "args": ["weight_kg", "height_m"]}},
+                    {"op": "derive", "column": "bmi",
+                     "expr": {"op": "div", "args": ["bmi", "height_m"]}},
+                ]}},
+            )
+            c.wait_for_results(t2["id"], timeout=60)
+            dfs = {d_["handle"]: d_ for d_ in
+                   c.session.dataframes(session["id"])}
+            assert dfs["with_bmi"]["ready"]
+            assert "bmi" in [col["name"] for col in
+                             dfs["with_bmi"]["columns"]]
+            # 3) COMPUTE on the derived frame (only aggregates travel)
+            t3 = c.task.create(
+                collaboration=collab["id"], organizations=all_orgs,
+                image="v6-average-py", session=session["id"],
+                databases=[{"label": "d", "type": "session",
+                            "dataframe": "with_bmi"}],
+                input_={"method": "partial_average",
+                        "kwargs": {"column": "bmi"}},
+            )
+            parts = c.wait_for_results(t3["id"], timeout=60)
+            pooled = pd.concat(frames, ignore_index=True)
+            pooled = pooled[pooled["age"] >= 18]
+            bmi = pooled["weight_kg"] / pooled["height_m"] ** 2
+            got = sum(p["sum"] for p in parts) / sum(
+                p["count"] for p in parts
+            )
+            np.testing.assert_allclose(got, bmi.mean(), rtol=1e-9)
+        finally:
+            for d in daemons:
+                d.stop()
+            http.stop()
+            srv.close()

@@ -59,6 +59,7 @@ BUILTIN_ALGORITHMS = {
     "v6-glm-py": "vantage6_tpu.workloads.glm",
     "v6-crosstab-py": "vantage6_tpu.workloads.stats",
     "v6-correlation-py": "vantage6_tpu.workloads.stats",
+    "v6-preprocess-py": "vantage6_tpu.workloads.preprocess",
     "v6-device-engine": "vantage6_tpu.workloads.device_engine",
 }
 
@@ -604,6 +605,7 @@ DEMO_STORE_IMAGES = (
     "v6-kaplan-meier-py",
     "v6-glm-py",
     "v6-crosstab-py",
+    "v6-preprocess-py",
 )
 
 
