@@ -122,9 +122,38 @@ class TestWizardStudySessionScreens:
             'id="studies"', 'id="st_create"', 'id="st_orgs"',
             'id="sessions"', 'id="se_create"', 'id="se_scope"',
             "loadWizardAlgos", "wizardKwargs", "renderWizardArgs",
-            "deleteSession",
+            "deleteSession", "killTask", 'id="s_detailpanel"',
+            "showStoreAlgo",
         ):
             assert anchor in page, anchor
+
+    def test_kill_flow(self, srv):
+        """The kill button's endpoint, driven as the page JS does."""
+        c = _login(srv)
+        org = c.post("/api/organization", {"name": "kill_org"}).json
+        collab = c.post(
+            "/api/collaboration",
+            {"name": "kill_collab", "organization_ids": [org["id"]]},
+        ).json
+        c.post(
+            "/api/node",
+            {"organization_id": org["id"],
+             "collaboration_id": collab["id"]},
+        )
+        import base64
+        import json as _json
+
+        blob = base64.b64encode(_json.dumps({"method": "m"}).encode()).decode()
+        task = c.post(
+            "/api/task",
+            {"name": "kill_me", "image": "x", "method": "m",
+             "collaboration_id": collab["id"],
+             "organizations": [{"id": org["id"], "input": blob}]},
+        ).json
+        r = c.post("/api/kill/task", {"task_id": task["id"]})
+        assert r.status == 200
+        got = c.get(f"/api/task/{task['id']}").json
+        assert got["status"] == "killed by user"
 
     def test_wizard_arg_types_covered(self, srv):
         """The wizard's typed-input builder handles every Argument.TYPE the

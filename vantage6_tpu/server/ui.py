@@ -166,6 +166,7 @@ a guided form, or stay freeform">
       <h2>Tasks</h2>
       <table id="tasks"><thead><tr>
         <th>id</th><th>name</th><th>image</th><th>method</th><th>status</th>
+        <th></th>
       </tr></thead><tbody></tbody></table>
     </div>
     <div class="panel hidden" id="detailpanel">
@@ -229,6 +230,13 @@ a guided form, or stay freeform">
         <th>id</th><th>name</th><th>image</th><th>status</th><th>functions</th>
       </tr></thead><tbody></tbody></table>
       <div id="storeerr" class="err"></div>
+    </div>
+    <div class="panel hidden" id="s_detailpanel">
+      <h2>Algorithm <span id="s_d_name"></span></h2>
+      <div id="s_d_desc" class="who"></div>
+      <table id="s_d_functions"><thead><tr>
+        <th>function</th><th>type</th><th>arguments</th><th>databases</th>
+      </tr></thead><tbody></tbody></table>
     </div>
     </div><!-- /tab_store -->
   </div>
@@ -330,8 +338,21 @@ async function refresh() {
   fill("tasks", tasks.data.slice().reverse(), (t) =>
     `<tr><td><a onclick="showTask(${Number(t.id)})">${Number(t.id)}</a></td>` +
     `<td>${esc(t.name)}</td><td>${esc(t.image)}</td>` +
-    `<td>${esc(t.method || "")}</td><td>${badge(t.status)}</td></tr>`);
+    `<td>${esc(t.method || "")}</td><td>${badge(t.status)}</td>` +
+    // terminal-only states hide the button; a failed sibling run still
+    // leaves OTHER runs consuming nodes, so failure states keep it
+    `<td>${["completed", "killed by user"].includes(t.status) ? "" :
+      `<button class="ghost" onclick="killTask(${Number(t.id)})">kill` +
+      `</button>`}</td></tr>`);
 }
+
+window.killTask = async function (id) {
+  try {
+    $("taskerr").textContent = "";
+    await api("POST", "kill/task", { task_id: id });
+    await refresh();
+  } catch (e) { $("taskerr").textContent = e.message; }
+};
 
 function fillStudyOrgs() {
   const collab = collabCache.find(
@@ -463,13 +484,30 @@ async function refreshStore() {
   $("s_url").textContent = info.url;
   try {
     const algos = await api("GET", "store/algorithm");
+    storeAlgoCache = algos.data;
     fill("s_algos", algos.data, (a) =>
-      `<tr><td>${Number(a.id)}</td><td>${esc(a.name)}</td>` +
+      `<tr><td><a onclick="showStoreAlgo(${Number(a.id)})">` +
+      `${Number(a.id)}</a></td><td>${esc(a.name)}</td>` +
       `<td>${esc(a.image)}</td><td>${badge(a.status)}</td>` +
       `<td>${esc((a.functions || []).map((f) => f.name).join(", "))}</td>` +
       `</tr>`);
   } catch (e) { $("storeerr").textContent = e.message; }
 }
+
+let storeAlgoCache = [];
+window.showStoreAlgo = function (id) {
+  const a = storeAlgoCache.find((x) => x.id === id);
+  if (!a) return;
+  $("s_d_name").textContent = `${a.name} (${a.image})`;
+  $("s_d_desc").textContent = a.description || "";
+  $("s_detailpanel").classList.remove("hidden");
+  fill("s_d_functions", a.functions || [], (f) =>
+    `<tr><td>${esc(f.display_name || f.name)}</td><td>${esc(f.type)}</td>` +
+    `<td>${esc((f.arguments || []).map((x) =>
+        `${x.name}:${x.type}${x.has_default ? "?" : ""}`).join(", "))}</td>` +
+    `<td>${esc((f.databases || []).map((d) => d.name).join(", "))}</td>` +
+    `</tr>`);
+};
 
 async function enter() {
   $("login").classList.add("hidden");
