@@ -128,6 +128,122 @@ class TestPasswordReset:
         assert not user.is_locked_out()
 
 
+class TestPasswordChange:
+    """Self-service /api/password/change: requires the CURRENT password
+    even with a valid token (a stolen session must not take the account)."""
+
+    def _login(self, srv, username, password):
+        c = srv.test_client()
+        r = c.post(
+            "/api/token/user", {"username": username, "password": password}
+        )
+        assert r.status == 200, r.json
+        c.token = r.json["access_token"]
+        return c
+
+    def test_change_and_relogin(self, srv, seeded):
+        c = self._login(srv, "erin", "erinpass1234")
+        r = c.post(
+            "/api/password/change",
+            {"current_password": "erinpass1234",
+             "new_password": "brandnewpass1"},
+        )
+        assert r.status == 200
+        # old password dead, new one works
+        bad = srv.test_client().post(
+            "/api/token/user",
+            {"username": "erin", "password": "erinpass1234"},
+        )
+        assert bad.status == 401
+        self._login(srv, "erin", "brandnewpass1")
+
+    def test_change_evicts_all_sessions(self, srv, seeded):
+        """A stolen session must not survive the victim's password change:
+        user tokens carry a credential fingerprint, so BOTH the old access
+        token and the old refresh token die the moment it rotates."""
+        victim = self._login(srv, "erin", "erinpass1234")
+        attacker = self._login(srv, "erin", "erinpass1234")  # stolen copy
+        attacker_refresh = srv.test_client().post(
+            "/api/token/user",
+            {"username": "erin", "password": "erinpass1234"},
+        ).json["refresh_token"]
+        r = victim.post(
+            "/api/password/change",
+            {"current_password": "erinpass1234",
+             "new_password": "brandnewpass1"},
+        )
+        assert r.status == 200
+        # the attacker's ACCESS token is dead...
+        got = attacker.get("/api/whoami")
+        assert got.status == 401, got.json
+        assert "superseded" in got.json["msg"]
+        # ...and their REFRESH token cannot mint new ones
+        ref = srv.test_client().post(
+            "/api/token/refresh", {"refresh_token": attacker_refresh}
+        )
+        assert ref.status == 401
+        # even the victim's own old token is dead; fresh login works
+        assert victim.get("/api/whoami").status == 401
+        self._login(srv, "erin", "brandnewpass1")
+
+    def test_guessing_feeds_lockout(self, srv, seeded):
+        """A token holder must not get a free password-guessing oracle:
+        wrong current_password counts toward the login lockout."""
+        c = self._login(srv, "erin", "erinpass1234")
+        for _ in range(5):
+            r = c.post(
+                "/api/password/change",
+                {"current_password": "wrong-guess-1",
+                 "new_password": "whatever12345"},
+            )
+            assert r.status == 401
+        locked = c.post(
+            "/api/password/change",
+            {"current_password": "erinpass1234",
+             "new_password": "whatever12345"},
+        )
+        assert locked.status == 401
+        assert "locked" in locked.json["msg"]
+
+    def test_wrong_current_password_rejected(self, srv, seeded):
+        c = self._login(srv, "erin", "erinpass1234")
+        r = c.post(
+            "/api/password/change",
+            {"current_password": "guess-guess-1",
+             "new_password": "brandnewpass1"},
+        )
+        assert r.status == 401
+        self._login(srv, "erin", "erinpass1234")  # unchanged
+
+    def test_short_new_password_rejected(self, srv, seeded):
+        c = self._login(srv, "erin", "erinpass1234")
+        r = c.post(
+            "/api/password/change",
+            {"current_password": "erinpass1234", "new_password": "short"},
+        )
+        assert r.status == 400
+
+    def test_requires_auth(self, srv, seeded):
+        r = srv.test_client().post(
+            "/api/password/change",
+            {"current_password": "x", "new_password": "longenough1"},
+        )
+        assert r.status == 401
+
+    def test_client_sdk_method(self, srv, seeded):
+        from vantage6_tpu.client import UserClient
+
+        http = srv.serve(port=0, background=True)
+        try:
+            uc = UserClient(http.url)
+            uc.authenticate("erin", "erinpass1234")
+            uc.change_password("erinpass1234", "sdkchanged123")
+            uc2 = UserClient(http.url)
+            uc2.authenticate("erin", "sdkchanged123")
+        finally:
+            http.stop()
+
+
 class TestTwoFactorReset:
     def test_2fa_lost_and_reset(self, srv, seeded):
         user = m.User.first(username="erin")

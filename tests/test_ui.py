@@ -123,7 +123,7 @@ class TestWizardStudySessionScreens:
             'id="sessions"', 'id="se_create"', 'id="se_scope"',
             "loadWizardAlgos", "wizardKwargs", "renderWizardArgs",
             "deleteSession", "killTask", 'id="s_detailpanel"',
-            "showStoreAlgo",
+            "showStoreAlgo", 'id="pw_change"', "password/change",
         ):
             assert anchor in page, anchor
 

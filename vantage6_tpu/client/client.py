@@ -107,6 +107,20 @@ class UserClient:
         self.whoami = data["user"]
         return data["user"]
 
+    def change_password(self, current_password: str, new_password: str) -> None:
+        """Self-service password change (requires the current password).
+
+        Every outstanding session — including THIS client's tokens — is
+        invalidated by the change; call authenticate() again after."""
+        self.request(
+            "POST",
+            "password/change",
+            {
+                "current_password": current_password,
+                "new_password": new_password,
+            },
+        )
+
     # ------------------------------------------------------------ encryption
     def setup_encryption(self, private_key: str | Path | None) -> None:
         """Enable E2E crypto (None -> explicit opt-out, DummyCryptor).

@@ -104,11 +104,23 @@ class TokenAuthority:
             self.secret,
         )
 
-    def user_tokens(self, user_id: int) -> dict[str, str]:
+    def user_tokens(
+        self, user_id: int, fingerprint: str | None = None
+    ) -> dict[str, str]:
+        """``fingerprint`` (credential_fingerprint of the user's current
+        password hash + TOTP secret) binds BOTH tokens to the credentials
+        they were issued under: a password change rotates the fingerprint
+        and every outstanding session dies — stateless revocation, the
+        same construction reset tokens use."""
         sub = {"type": "user", "id": user_id}
+        extra = {"pwh": fingerprint} if fingerprint else {}
         return {
-            "access_token": self._mint({"sub": sub, "use": "access"}, self.ACCESS_TTL),
-            "refresh_token": self._mint({"sub": sub, "use": "refresh"}, self.REFRESH_TTL),
+            "access_token": self._mint(
+                {"sub": sub, "use": "access", **extra}, self.ACCESS_TTL
+            ),
+            "refresh_token": self._mint(
+                {"sub": sub, "use": "refresh", **extra}, self.REFRESH_TTL
+            ),
         }
 
     def node_tokens(self, node_id: int) -> dict[str, str]:
@@ -183,13 +195,37 @@ class TokenAuthority:
 
     # ------------------------------------------------------------ validation
     def identity(self, token: str, use: str = "access") -> dict[str, Any]:
+        return self.identity_claims(token, use)[0]
+
+    def identity_claims(
+        self, token: str, use: str = "access"
+    ) -> tuple[dict[str, Any], dict[str, Any]]:
+        """(subject, full claims) — callers needing the credential
+        fingerprint ("pwh") read it from the claims."""
         claims = decode_jwt(token, self.secret)
         if claims.get("use") != use:
             raise AuthError(f"expected a {use} token")
         sub = claims.get("sub")
         if not isinstance(sub, dict) or "type" not in sub:
             raise AuthError("malformed subject")
-        return sub
+        return sub, claims
+
+    def fingerprint_ok(
+        self,
+        claims: dict[str, Any],
+        password_hash: str | None,
+        totp_secret: str | None,
+    ) -> bool:
+        """False when the token carries a credential fingerprint that no
+        longer matches — i.e. the password/2FA changed after issuance. A
+        token WITHOUT a fingerprint passes (node/container tokens; the
+        claim cannot be stripped — the JWT is signed)."""
+        pwh = claims.get("pwh")
+        if not pwh:
+            return True
+        return hmac.compare_digest(
+            pwh, self._credential_fingerprint(password_hash, totp_secret)
+        )
 
     def refresh(self, refresh_token: str) -> dict[str, str]:
         sub = self.identity(refresh_token, use="refresh")

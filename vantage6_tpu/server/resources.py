@@ -31,7 +31,7 @@ def identity_from_token(srv: "ServerApp", token: str | None) -> tuple[str, Any]:
     if not token:
         raise HTTPError(401, "missing bearer token")
     try:
-        sub = srv.tokens.identity(token)
+        sub, claims = srv.tokens.identity_claims(token)
     except AuthError as e:
         raise HTTPError(401, str(e)) from None
     kind = sub["type"]
@@ -39,6 +39,12 @@ def identity_from_token(srv: "ServerApp", token: str | None) -> tuple[str, Any]:
         user = m.User.get(sub["id"])
         if user is None:
             raise HTTPError(401, "unknown user")
+        if not srv.tokens.fingerprint_ok(
+            claims, user.password_hash, user.totp_secret
+        ):
+            # credentials rotated after issuance: the session is dead —
+            # this is what makes a password change evict a stolen session
+            raise HTTPError(401, "token superseded by a credential change")
         return "user", user
     if kind == "node":
         node = m.Node.get(sub["id"])
@@ -180,7 +186,15 @@ def register_resources(srv: "ServerApp") -> None:
                 user.record_login(False)
                 raise HTTPError(401, "MFA code required or invalid")
         user.record_login(True)
-        return {**srv.tokens.user_tokens(user.id), "user": user.to_dict()}
+        return {
+            **srv.tokens.user_tokens(
+                user.id,
+                fingerprint=srv.tokens._credential_fingerprint(
+                    user.password_hash, user.totp_secret
+                ),
+            ),
+            "user": user.to_dict(),
+        }
 
     @app.route("/api/token/node", methods=("POST",))
     def token_node(req: Request):
@@ -210,9 +224,31 @@ def register_resources(srv: "ServerApp") -> None:
     def token_refresh(req: Request):
         body = sch.load(sch.RefreshInput(), req.json)
         try:
-            return srv.tokens.refresh(body["refresh_token"])
+            sub, claims = srv.tokens.identity_claims(
+                body["refresh_token"], use="refresh"
+            )
         except AuthError as e:
             raise HTTPError(401, str(e)) from None
+        if sub["type"] == "user":
+            user = m.User.get(sub["id"])
+            if user is None:
+                raise HTTPError(401, "unknown user")
+            if not srv.tokens.fingerprint_ok(
+                claims, user.password_hash, user.totp_secret
+            ):
+                # a stolen refresh token must not outlive a password change
+                raise HTTPError(
+                    401, "token superseded by a credential change"
+                )
+            return srv.tokens.user_tokens(
+                user.id,
+                fingerprint=srv.tokens._credential_fingerprint(
+                    user.password_hash, user.totp_secret
+                ),
+            )
+        if sub["type"] == "node":
+            return srv.tokens.node_tokens(sub["id"])
+        raise HTTPError(401, "container tokens cannot be refreshed")
 
     # ------------------------------------------------------------- recovery
     # Parity: the reference's recover.py — password reset (and 2FA reset)
@@ -251,6 +287,28 @@ def register_resources(srv: "ServerApp") -> None:
         user.failed_login_attempts = 0
         user.save()
         return {"msg": "password updated"}
+
+    @app.route("/api/password/change", methods=("POST",))
+    def password_change(req: Request):
+        """Self-service password change (reference: /password/change):
+        the CURRENT password is required even with a valid token, so a
+        stolen session cannot silently take over the account. Wrong
+        guesses feed the lockout counter — a token holder must not get a
+        free password-guessing oracle (same stance as recover_2fa_lost) —
+        and a successful change rotates the credential fingerprint, which
+        kills every outstanding token including the attacker's."""
+        user = _require_user(srv, req)
+        body = sch.load(sch.PasswordChangeInput(), req.json)
+        if user.is_locked_out():
+            raise HTTPError(401, "account locked, retry later")
+        if not user.check_password(body["current_password"]):
+            user.record_login(False)
+            raise HTTPError(401, "current password is incorrect")
+        user.set_password(body["new_password"])
+        user.failed_login_attempts = 0
+        user.save()
+        return {"msg": "password updated — all sessions are now invalid; "
+                       "log in again"}
 
     @app.route("/api/recover/2fa/lost", methods=("POST",))
     def recover_2fa_lost(req: Request):

@@ -156,6 +156,11 @@ class TaskInput(_Base):
     )
 
 
+class PasswordChangeInput(_Base):
+    current_password = fields.Str(required=True)
+    new_password = fields.Str(required=True, validate=validate.Length(min=8))
+
+
 class RunPatch(_Base):
     # a free-form status would later make TaskStatus(run.status) raise (500)
     # and Task.status() misclassify the run — reject it at the boundary

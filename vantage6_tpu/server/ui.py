@@ -221,6 +221,18 @@ a guided form, or stay freeform">
       </div>
       <div id="roleerr" class="err"></div>
     </div>
+    <div class="panel">
+      <h2>My account</h2>
+      <div class="row">
+        <input id="pw_current" type="password" placeholder="current password"
+               autocomplete="current-password" size="18">
+        <input id="pw_new" type="password" placeholder="new password (min 8)"
+               autocomplete="new-password" size="18">
+        <button id="pw_change">Change password</button>
+        <span id="pw_msg" class="who"></span>
+      </div>
+      <div id="pwerr" class="err"></div>
+    </div>
     </div><!-- /tab_admin -->
 
     <div id="tab_store" class="hidden">
@@ -471,6 +483,18 @@ $("r_create").onclick = async () => {
     $("r_name").value = "";
     await refreshAdmin();
   } catch (e) { $("roleerr").textContent = e.message; }
+};
+
+$("pw_change").onclick = async () => {
+  try {
+    $("pwerr").textContent = ""; $("pw_msg").textContent = "";
+    await api("POST", "password/change", {
+      current_password: $("pw_current").value,
+      new_password: $("pw_new").value,
+    });
+    $("pw_current").value = ""; $("pw_new").value = "";
+    $("pw_msg").textContent = "password updated";
+  } catch (e) { $("pwerr").textContent = e.message; }
 };
 
 // ------------------------------------------------------------------ store
