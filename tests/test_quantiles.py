@@ -1,0 +1,83 @@
+"""Federated quantiles: bisection over count-below rounds must match the
+pooled numpy quantile without any station sharing a value."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from vantage6_tpu.runtime.federation import federation_from_datasets
+from vantage6_tpu.workloads import quantiles
+
+
+def _run(frames, **kwargs):
+    fed = federation_from_datasets(frames, {"v6-quantiles": quantiles})
+    task = fed.create_task(
+        "v6-quantiles",
+        {"method": "central_quantile", "kwargs": kwargs},
+        organizations=[0],
+    )
+    return fed.wait_for_results(task.id)[0]
+
+
+def _frames(seed=0, sizes=(80, 120, 50)):
+    rng = np.random.default_rng(seed)
+    return [
+        pd.DataFrame({"age": rng.normal(50 + 5 * i, 12, n)})
+        for i, n in enumerate(sizes)
+    ]
+
+
+class TestQuantile:
+    @pytest.mark.parametrize("q", [0.1, 0.5, 0.9])
+    def test_matches_pooled_rank_value(self, q):
+        frames = _frames()
+        out = _run(frames, column="age", q=q)
+        pooled = np.sort(
+            pd.concat(frames, ignore_index=True)["age"].to_numpy()
+        )
+        target = int(np.ceil(q * len(pooled)))
+        exact = pooled[target - 1]  # smallest value with rank >= target
+        assert abs(out["value"] - exact) <= 2e-6
+        assert out["n"] == len(pooled)
+
+    def test_caller_supplied_range_skips_bounds_round(self):
+        frames = _frames(seed=3)
+        out = _run(frames, column="age", q=0.5, lo=-200.0, hi=300.0)
+        assert out["bounds_rounds"] == 0
+        pooled = np.sort(
+            pd.concat(frames, ignore_index=True)["age"].to_numpy()
+        )
+        exact = pooled[int(np.ceil(0.5 * len(pooled))) - 1]
+        assert abs(out["value"] - exact) <= 2e-6
+
+    def test_missing_values_are_complete_case(self):
+        frames = _frames(seed=5)
+        frames[1].loc[:30, "age"] = np.nan
+        out = _run(frames, column="age", q=0.5)
+        pooled = pd.concat(frames, ignore_index=True)["age"].dropna()
+        assert out["n"] == len(pooled)
+        srt = np.sort(pooled.to_numpy())
+        exact = srt[int(np.ceil(0.5 * len(srt))) - 1]
+        assert abs(out["value"] - exact) <= 2e-6
+
+    def test_too_small_hi_fails_loudly(self):
+        frames = _frames(seed=7)
+        with pytest.raises(Exception, match="widen the range"):
+            _run(frames, column="age", q=0.9, lo=0.0, hi=10.0)
+
+    def test_too_large_lo_fails_loudly(self):
+        # median ~50-ish; lo=100 would otherwise silently converge to 100
+        frames = _frames(seed=9)
+        with pytest.raises(Exception, match="lower lo"):
+            _run(frames, column="age", q=0.5, lo=100.0, hi=300.0)
+
+    def test_quantile_at_the_minimum(self):
+        # auto-bounds path: tiny q targets the global min; bisection must
+        # converge onto it, not stall or raise
+        frames = _frames(seed=11, sizes=(40, 40, 40))
+        out = _run(frames, column="age", q=0.005)
+        pooled = pd.concat(frames, ignore_index=True)["age"].to_numpy()
+        assert abs(out["value"] - pooled.min()) <= 2e-6
+
+    def test_bad_q_rejected(self):
+        with pytest.raises(Exception, match="q must be"):
+            _run(_frames(), column="age", q=1.5)

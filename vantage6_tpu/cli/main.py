@@ -60,6 +60,7 @@ BUILTIN_ALGORITHMS = {
     "v6-crosstab-py": "vantage6_tpu.workloads.stats",
     "v6-correlation-py": "vantage6_tpu.workloads.stats",
     "v6-preprocess-py": "vantage6_tpu.workloads.preprocess",
+    "v6-quantiles-py": "vantage6_tpu.workloads.quantiles",
     "v6-device-engine": "vantage6_tpu.workloads.device_engine",
 }
 
@@ -606,6 +607,7 @@ DEMO_STORE_IMAGES = (
     "v6-glm-py",
     "v6-crosstab-py",
     "v6-preprocess-py",
+    "v6-quantiles-py",
 )
 
 

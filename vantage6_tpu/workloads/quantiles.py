@@ -1,0 +1,159 @@
+"""Federated quantiles (median and friends) by iterative bisection.
+
+Parity with the reference ecosystem's federated-median need (the same
+count-query construction its quantile discussions use): no station ever
+shares a value — each round the central proposes a cut point and every
+station reports only HOW MANY of its rows fall at or below it; binary
+search converges on the value whose global rank matches the requested
+quantile. Disclosure per round is one aggregate count per station, the
+same granularity as the summary-statistics algorithm.
+
+Search range: pass ``lo``/``hi`` when the schema bounds are known (ages,
+percentages — zero extra disclosure). Without them, a bounds round asks
+each station for its EXACT local min/max — explicitly a disclosure of the
+two extreme values per station (e.g. the oldest patient's age), stated
+here rather than hidden, exactly like the KM grid's shared event times;
+supply lo/hi whenever that disclosure matters.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from vantage6_tpu.algorithm.decorators import algorithm_client, data
+
+
+@data(1)
+def partial_count_below(df: Any, column: str, cut: float) -> dict[str, Any]:
+    """#rows with value <= cut, plus this station's total (complete-case)."""
+    vals = df[column].dropna()
+    return {"below": int((vals <= cut).sum()), "count": int(len(vals))}
+
+
+@data(1)
+def partial_bounds(df: Any, column: str) -> dict[str, Any]:
+    """Local [min, max] + row count of the column — the documented
+    disclosure the range round costs when the caller cannot supply lo/hi
+    (the count rides along so no extra rank round is needed)."""
+    vals = df[column].dropna()
+    if len(vals) == 0:
+        return {"lo": None, "hi": None, "count": 0}
+    return {
+        "lo": float(vals.min()),
+        "hi": float(vals.max()),
+        "count": int(len(vals)),
+    }
+
+
+@algorithm_client
+def central_quantile(
+    client: Any,
+    column: str,
+    q: float = 0.5,
+    lo: float | None = None,
+    hi: float | None = None,
+    tol: float = 1e-6,
+    max_iter: int = 64,
+    organizations: list[int] | None = None,
+) -> dict[str, Any]:
+    """The q-quantile of the pooled column without pooling any rows.
+
+    Bisection on the value axis: maintains [lo, hi] bracketing the value
+    whose global rank is ceil(q * n); each bisection step is one
+    count-below task round (``max_iter`` bounds the bisection steps; the
+    returned ``task_rounds`` additionally counts the bounds/bracket
+    rounds). 64 steps halve the bracket to ~2^-64 of its width — float64
+    exact for any practical range.
+    """
+    if not 0.0 < q < 1.0:
+        raise ValueError("q must be in (0, 1)")
+    orgs = organizations or [o["id"] for o in client.organization.list()]
+
+    def fanout(method: str, kwargs: dict) -> list[dict]:
+        task = client.task.create(
+            input_={"method": method, "kwargs": kwargs},
+            organizations=orgs,
+            name=f"quantile_{method}",
+        )
+        return client.wait_for_results(task_id=task["id"])
+
+    def count_below(cut: float) -> int:
+        return sum(
+            p["below"]
+            for p in fanout(
+                "partial_count_below", {"column": column, "cut": cut}
+            )
+        )
+
+    task_rounds = 0
+    bounds_rounds = 0
+    auto_bounds = lo is None or hi is None
+    n = None
+    if auto_bounds:
+        parts = fanout("partial_bounds", {"column": column})
+        task_rounds += 1
+        bounds_rounds = 1
+        los = [p["lo"] for p in parts if p["lo"] is not None]
+        his = [p["hi"] for p in parts if p["hi"] is not None]
+        if not los:
+            raise ValueError("no station holds any rows for this column")
+        lo = min(los) if lo is None else lo
+        hi = max(his) if hi is None else hi
+        n = sum(p["count"] for p in parts)
+    lo, hi = float(lo), float(hi)
+    if not hi >= lo:
+        raise ValueError(f"invalid range [{lo}, {hi}]")
+
+    if n is None:
+        # caller-supplied bounds: one rank round at hi learns n AND proves
+        # the quantile is bracketed from above
+        parts = fanout("partial_count_below", {"column": column, "cut": hi})
+        task_rounds += 1
+        n = sum(p["count"] for p in parts)
+        if n == 0:
+            raise ValueError("no rows across the federation")
+        below_hi = sum(p["below"] for p in parts)
+        target = int(np.ceil(q * n))
+        if below_hi < target:
+            # values above hi exist (caller-supplied hi too small): the
+            # quantile is not bracketed — fail loudly rather than clamp
+            raise ValueError(
+                f"hi={hi} has global rank {below_hi} < target {target}; "
+                "widen the range"
+            )
+        # ...and the lo side must bracket from BELOW, or bisection would
+        # silently converge onto the caller's lo and return a wrong value
+        below_lo = count_below(lo)
+        task_rounds += 1
+        if below_lo >= target:
+            raise ValueError(
+                f"lo={lo} already has global rank {below_lo} >= target "
+                f"{target}: the quantile lies at or below lo; lower lo"
+            )
+    else:
+        if n == 0:
+            raise ValueError("no rows across the federation")
+        target = int(np.ceil(q * n))
+        # auto bounds: hi is the true global max (rank n >= target) and lo
+        # the true min — bisection converges to the min when the quantile
+        # IS the min, so no extra bracket rounds are needed
+
+    bisections = 0
+    while hi - lo > tol and bisections < max_iter:
+        mid = 0.5 * (lo + hi)
+        if count_below(mid) >= target:
+            hi = mid
+        else:
+            lo = mid
+        task_rounds += 1
+        bisections += 1
+    return {
+        "quantile": q,
+        "value": float(hi),
+        "n": int(n),
+        "bisection_steps": bisections,
+        "task_rounds": task_rounds,
+        "bounds_rounds": bounds_rounds,
+        "bracket": [float(lo), float(hi)],
+    }
