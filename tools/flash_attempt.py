@@ -70,7 +70,10 @@ def child() -> None:
 
 
 def probe() -> str:
-    """Is the tunnel still alive after the attempt?"""
+    """Tunnel health (run BEFORE the attempt to distinguish 'kernel hung'
+    from 'tunnel was already dead', and AFTER to record the damage).
+    Healthy results START with 'alive' — check with startswith, never a
+    substring (error text can contain 'alive', e.g. 'keepalive')."""
     code = (
         "import jax, jax.numpy as jnp;"
         "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
@@ -92,6 +95,18 @@ def probe() -> str:
 def main() -> None:
     started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     outcome: dict = {"attempted_at": started, "child_timeout_s": CHILD_TIMEOUT_S}
+    # pre-probe: a tunnel that is ALREADY wedged would make a child hang
+    # look like a kernel failure — record the distinction
+    outcome["tunnel_before"] = probe()
+    if not outcome["tunnel_before"].startswith("alive"):
+        outcome["flash"] = (
+            "blocked: tunnel unhealthy BEFORE the attempt "
+            f"({outcome['tunnel_before']}); the kernel was never reached — "
+            "re-run when the tunnel recovers"
+        )
+        ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
+        print(json.dumps(outcome))
+        return
     try:
         p = subprocess.run(
             [sys.executable, str(Path(__file__).resolve()), "--child"],
