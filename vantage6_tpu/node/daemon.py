@@ -45,6 +45,7 @@ class NodeDaemon:
         private_key: str | Path | None = None,
         mode: str = "sandbox",
         poll_interval: float = 0.25,
+        sync_interval: float = 15.0,
         name: str = "",
         max_concurrent_runs: int = 4,
         station_secret: str | bytes | None = None,
@@ -73,6 +74,7 @@ class NodeDaemon:
         self.api_url = api_url.rstrip("/")
         self.api_key = api_key
         self.poll_interval = poll_interval
+        self.sync_interval = sync_interval
         self._access_token: str | None = None
         self._refresh_token: str | None = None
         self._rest = RestSession(
@@ -101,6 +103,7 @@ class NodeDaemon:
             queue.PriorityQueue()
         )
         self._device_thread: threading.Thread | None = None
+        self._sync_thread: threading.Thread | None = None
 
         # authenticate (reference: Node.__init__ authenticates first)
         data = self._post_raw(
@@ -219,8 +222,12 @@ class NodeDaemon:
         self._cursor = self.request("GET", "event", params={"since": 0})[
             "cursor"
         ]
-        self._sync_missed_runs()
+        self._sync_missed_runs(include_orphans=True)
         self._reconcile_sessions()
+        self._sync_thread = threading.Thread(
+            target=self._sync_worker, daemon=True, name="v6t-sync"
+        )
+        self._sync_thread.start()
         if self.runner.device_engine:
             self._device_thread = threading.Thread(
                 target=self._device_worker, daemon=True,
@@ -240,6 +247,8 @@ class NodeDaemon:
             self._thread.join(timeout=10)
         if self._device_thread:
             self._device_thread.join(timeout=10)
+        if self._sync_thread:
+            self._sync_thread.join(timeout=10)
         self._pool.shutdown(wait=True, cancel_futures=True)
         try:
             self.request("PATCH", f"node/{self.id}", {"status": "offline"})
@@ -337,12 +346,23 @@ class NodeDaemon:
             self._claimed.add(run_id)
         self._pool.submit(self._execute_logged, run_id)
 
+    def _unclaim(self, run_id: int) -> None:
+        """Give a run back to the sweep after a failure that never reached
+        a terminal status patch — a claimed-but-dead run would otherwise be
+        orphaned for this daemon's whole life."""
+        with self._claim_lock:
+            self._claimed.discard(run_id)
+
     def _execute_logged(self, run_id: int, dispatched: bool = False) -> None:
         try:
             self._execute(run_id, dispatched=dispatched)
         except Exception:
             log.error("run %s worker crashed:\n%s", run_id,
                       traceback.format_exc(limit=8))
+            # whatever state the run is in, this thread is done with it; if
+            # the crash left it non-terminal, the anti-entropy sweep (or a
+            # restart) must be able to pick it up again
+            self._unclaim(run_id)
 
     def _device_worker(self) -> None:
         """Drain device-engine runs one at a time, lowest task id first.
@@ -510,27 +530,83 @@ class NodeDaemon:
                 return out
             page += 1
 
-    def _sync_missed_runs(self) -> None:
+    def _sync_missed_runs(self, include_orphans: bool = False) -> None:
         """Reference: sync_task_queue_with_server — execute runs queued
         while the node was offline. Server-side status filter + full page
-        drain: pending work must never hide behind page 1 of history."""
-        page = 1
-        while True:
-            body = self.request(
-                "GET",
-                "run",
-                params={
-                    "status": TaskStatus.PENDING.value,
-                    "per_page": 250,
-                    "page": page,
-                },
-            )
-            for run in body["data"]:
-                self._submit(run["id"])
-            total = body.get("pagination", {}).get("total", 0)
-            if page * 250 >= total or not body["data"]:
-                return
-            page += 1
+        drain: pending work must never hide behind page 1 of history.
+
+        With ``include_orphans`` (restart time only), runs this node left
+        INITIALIZING/ACTIVE in a previous daemon life are reset to pending
+        on the server and re-executed: this daemon is the ONLY executor its
+        runs will ever have, so anything non-terminal it does not currently
+        own is orphaned by definition (the claim set is empty at start).
+        The same sweep runs periodically WITHOUT orphan reclaim (see
+        ``_sync_worker``) as anti-entropy against lost events — the claim
+        set makes re-submission idempotent."""
+        statuses = [TaskStatus.PENDING]
+        if include_orphans:
+            statuses += [TaskStatus.INITIALIZING, TaskStatus.ACTIVE]
+        for status in statuses:
+            mutating = status is not TaskStatus.PENDING
+            page = 1
+            while True:
+                # the orphan pass MUTATES the filtered set (each PATCH
+                # removes a run from this status), so it must re-fetch page
+                # 1 until the set drains — incrementing the page would skip
+                # everything the shrinkage slid onto page 1
+                body = self.request(
+                    "GET",
+                    "run",
+                    params={
+                        "status": status.value,
+                        "per_page": 250,
+                        "page": page,
+                    },
+                )
+                progressed = 0
+                for run in body["data"]:
+                    if mutating:
+                        try:
+                            self.request(
+                                "PATCH",
+                                f"run/{run['id']}",
+                                {
+                                    "status": TaskStatus.PENDING.value,
+                                    "log": "node restarted mid-run; "
+                                           "re-queued by startup sync",
+                                },
+                            )
+                        except Exception as e:
+                            # e.g. 409: finished/killed between list + patch
+                            log.info(
+                                "orphan run %s not re-queued: %s",
+                                run["id"], e,
+                            )
+                            continue
+                        progressed += 1
+                    self._submit(run["id"])
+                if not body["data"]:
+                    break
+                if mutating:
+                    if progressed == 0:
+                        break  # nothing transitioned: avoid spinning
+                    continue  # re-fetch page 1 of the shrunken set
+                total = body.get("pagination", {}).get("total", 0)
+                if page * 250 >= total:
+                    break
+                page += 1
+
+    def _sync_worker(self) -> None:
+        """Periodic pending-run sweep (anti-entropy). Events remain the fast
+        path; this closes the gaps events cannot guarantee against — a hub
+        replay buffer overflow between polls, a dropped socket frame, or a
+        run whose first execution attempt failed before any status patch
+        (those are un-claimed on failure so the sweep can retry them)."""
+        while not self._stop.wait(self.sync_interval):
+            try:
+                self._sync_missed_runs()
+            except Exception as e:
+                log.warning("anti-entropy run sweep failed: %s", e)
 
     def _reconcile_sessions(self) -> None:
         """Drop local session stores whose server session no longer exists.
@@ -565,6 +641,7 @@ class NodeDaemon:
             run = self.request("GET", f"run/{run_id}")
         except Exception as e:
             log.error("cannot fetch run %s: %s", run_id, e)
+            self._unclaim(run_id)  # still pending server-side: retryable
             return
         if run["status"] != TaskStatus.PENDING.value or run_id in self._killed:
             return

@@ -130,6 +130,23 @@ def _user_for_reset_token(srv: "ServerApp", token: str) -> m.User:
     return user
 
 
+def _grant_role_rules(
+    user: m.User, role: m.Role, rule_ids: list[int], *, replace: bool = False
+) -> None:
+    """Attach rules to a role — the grantor may only hand out rules they
+    hold themselves (reference rule; without this, any role-CREATE/EDIT
+    holder could mint a super-role). Shared by role create and PATCH."""
+    own = user.rule_ids()
+    for rid in rule_ids:
+        if rid not in own:
+            raise HTTPError(403, f"cannot grant rule {rid} you do not have")
+    if replace:
+        for rid in list(role.rule_ids()):
+            m.role_rule.remove(role.id, rid)
+    for rid in rule_ids:
+        role.add_rule(_get_or_404(m.Rule, rid))
+
+
 def _check_role_grant(user: m.User, role_ids: list[int]) -> list[m.Role]:
     """A grantor may only hand out roles whose rules they hold themselves —
     without this, any user-EDIT holder could self-assign Root."""
@@ -1043,7 +1060,15 @@ def register_resources(srv: "ServerApp") -> None:
                     if m.Task.get(r.task_id).collaboration_id in visible
                 ]
         elif kind == "node":
-            rows = [r for r in rows if r.organization_id == principal.organization_id]
+            # org AND collaboration: a node is per (org, collaboration), and
+            # a sibling node of the same org in another collaboration must
+            # not see (or reclaim — daemon._sync_missed_runs) these runs
+            rows = [
+                r for r in rows
+                if r.organization_id == principal.organization_id
+                and m.Task.get(r.task_id).collaboration_id
+                == principal.collaboration_id
+            ]
         else:  # container: runs of its own task tree (job) only
             own_job = _container_task(principal).job_id
             job_tasks = {t.id for t in m.Task.list(job_id=own_job)}
@@ -1065,11 +1090,15 @@ def register_resources(srv: "ServerApp") -> None:
                     )
                 )
             elif kind == "node":
-                _check(run.organization_id == principal.organization_id)
+                _check(
+                    run.organization_id == principal.organization_id
+                    and task.collaboration_id == principal.collaboration_id
+                )
             else:  # container: its own task tree (job) only
                 _check(task.job_id == _container_task(principal).job_id)
             return run.to_dict()
-        # PATCH: only the executing node updates status/result
+        # PATCH: only the executing node updates status/result (org AND
+        # collaboration — same scoping as the node's run listing)
         node = _require_node(srv, req)
         _check(
             run.organization_id == node.organization_id
@@ -1120,37 +1149,38 @@ def register_resources(srv: "ServerApp") -> None:
             if org_id
             else pm.user_scope(user, "role", Operation.CREATE) == Scope.GLOBAL
         )
-        # may only grant rules the grantor holds (reference rule)
-        own = user.rule_ids()
-        for rid in body["rules"]:
-            if rid not in own:
-                raise HTTPError(403, f"cannot grant rule {rid} you do not have")
         role = m.Role(
             name=body["name"],
             description=body["description"],
             organization_id=org_id,
         ).save()
-        for rid in body["rules"]:
-            role.add_rule(_get_or_404(m.Rule, rid))
+        _grant_role_rules(user, role, body["rules"])
         return role.to_dict(), 201
 
-    @app.route("/api/role/<int:id>", methods=("GET", "DELETE"))
+    @app.route("/api/role/<int:id>", methods=("GET", "PATCH", "DELETE"))
     def role_one(req: Request, id: int):
         user = _require_user(srv, req)
         role = _get_or_404(m.Role, id)
         if req.method == "GET":
             _check(pm.user_scope(user, "role", Operation.VIEW) is not None)
             return role.to_dict()
+        op = Operation.EDIT if req.method == "PATCH" else Operation.DELETE
         _check(
-            pm.allowed(
-                user, "role", Operation.DELETE,
-                organization_id=role.organization_id,
-            )
+            pm.allowed(user, "role", op, organization_id=role.organization_id)
             if role.organization_id
-            else pm.user_scope(user, "role", Operation.DELETE) == Scope.GLOBAL
+            else pm.user_scope(user, "role", op) == Scope.GLOBAL
         )
-        role.delete()
-        return {}, 204
+        if req.method == "DELETE":
+            role.delete()
+            return {}, 204
+        body = sch.load(sch.RolePatch(), req.json)
+        for field in ("name", "description"):
+            if body[field] is not None:
+                setattr(role, field, body[field])
+        if body["rules"] is not None:
+            _grant_role_rules(user, role, body["rules"], replace=True)
+        role.save()
+        return role.to_dict()
 
     @app.route("/api/rule", methods=("GET",))
     def rules(req: Request):
@@ -1214,31 +1244,83 @@ def register_resources(srv: "ServerApp") -> None:
         _identity(srv, req)
         return {"url": srv.store_url}
 
-    @app.route("/api/store/algorithm", methods=("GET",))
-    def store_algorithms(req: Request):
-        """Same-origin proxy to the linked store's public (approved)
-        algorithm registry, so the browser UI can browse the store without
-        cross-origin requests or separate store credentials."""
+    def _store_forward(
+        req: Request, path: str, *,
+        params: dict[str, Any] | None = None,
+        forward_auth: bool = True,
+    ):
+        """Same-origin proxy to the linked store, so the browser UI drives
+        the FULL store workflow (submit → review → approve) without
+        cross-origin requests or separate store credentials. The caller's
+        bearer token is forwarded together with a ``Server-Url`` naming THIS
+        server (derived from the request's Host — the URL the browser used
+        IS the URL the store's trust handshake will call ``whoami`` on)."""
         _identity(srv, req)
         if not srv.store_url:
             raise HTTPError(404, "no algorithm store linked to this server")
         import requests
 
+        headers: dict[str, str] = {}
+        if forward_auth and req.bearer_token:
+            host = req.headers.get("host")
+            if host:
+                proto = req.headers.get("x-forwarded-proto", "http")
+                headers["Authorization"] = f"Bearer {req.bearer_token}"
+                headers["Server-Url"] = f"{proto}://{host}"
+        body = req.json if req.method in ("POST", "PATCH") else None
         try:
-            resp = requests.get(
-                f"{srv.store_url}/api/algorithm",
-                params={
-                    k: req.arg(k)
-                    for k in ("status", "name")
-                    if req.arg(k) is not None
-                },
+            resp = requests.request(
+                req.method,
+                f"{srv.store_url}/api/{path}",
+                json=body,
+                params=params or {},
+                headers=headers,
                 timeout=10,
             )
         except requests.RequestException as e:
             raise HTTPError(502, f"store unreachable: {e}") from None
-        if resp.status_code != 200:
-            raise HTTPError(502, f"store error {resp.status_code}")
-        return resp.json()
+        if resp.status_code >= 400:
+            try:
+                msg = resp.json().get("msg", "")
+            except Exception:
+                msg = resp.text[:200]
+            raise HTTPError(resp.status_code, f"store: {msg}")
+        data = {} if resp.status_code == 204 else resp.json()
+        return data, resp.status_code
+
+    @app.route("/api/store/algorithm", methods=("GET", "POST"))
+    def store_algorithms(req: Request):
+        """GET: the algorithm registry (token forwarded only when a status
+        filter asks for non-public rows, so the default listing stays the
+        approved set exactly as before). POST: submit an algorithm."""
+        params = {
+            k: req.arg(k)
+            for k in ("status", "name")
+            if req.arg(k) is not None
+        }
+        return _store_forward(
+            req, "algorithm", params=params,
+            forward_auth=req.method == "POST" or "status" in params,
+        )
+
+    @app.route("/api/store/algorithm/<int:id>", methods=("GET", "DELETE"))
+    def store_algorithm_one(req: Request, id: int):
+        return _store_forward(req, f"algorithm/{id}")
+
+    @app.route("/api/store/algorithm/<int:id>/review", methods=("POST",))
+    def store_start_review(req: Request, id: int):
+        return _store_forward(req, f"algorithm/{id}/review")
+
+    @app.route("/api/store/review", methods=("GET",))
+    def store_reviews(req: Request):
+        params = {}
+        if req.int_arg("algorithm_id") is not None:
+            params["algorithm_id"] = req.int_arg("algorithm_id")
+        return _store_forward(req, "review", params=params)
+
+    @app.route("/api/store/review/<int:id>", methods=("GET", "PATCH"))
+    def store_review_one(req: Request, id: int):
+        return _store_forward(req, f"review/{id}")
 
     # --------------------------------------------------------------- events
     @app.route("/api/event", methods=("GET",))

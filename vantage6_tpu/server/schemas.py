@@ -181,6 +181,12 @@ class RoleInput(_Base):
     rules = fields.List(fields.Int(), load_default=list)
 
 
+class RolePatch(_Base):
+    name = fields.Str(load_default=None)
+    description = fields.Str(load_default=None)
+    rules = fields.List(fields.Int(), load_default=None)
+
+
 class PortInput(_Base):
     run_id = fields.Int(required=True)
     port = fields.Int(required=True, validate=validate.Range(min=1, max=65535))

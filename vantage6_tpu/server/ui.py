@@ -3,9 +3,14 @@
 Parity: the reference ships an Angular SPA (SURVEY.md §2 item 27) for
 administration and task management. Here a dependency-free single-page app
 (vanilla JS + the server's own REST API) is served by the control plane
-itself at ``/`` — login, collaborations, node liveness, task submission and
-result inspection. Deliberately buildless: one HTML document, no bundler,
-no CDN (zero-egress deployments), trivially auditable.
+itself at ``/`` — login/MFA, collaborations, node liveness, task submission
+(freeform + store-metadata wizard), a full run-log/result viewer with
+per-run timing, studies/sessions, admin CRUD (organizations, users, roles)
+with rule-level role management and user role assignment, and the COMPLETE
+store workflow in the browser: browse by status, submit an algorithm,
+start a review, approve/reject with comment (same-origin proxy,
+resources.py `_store_forward`). Deliberately buildless: one HTML document,
+no bundler, no CDN (zero-egress deployments), trivially auditable.
 """
 from __future__ import annotations
 
@@ -172,8 +177,14 @@ a guided form, or stay freeform">
     <div class="panel hidden" id="detailpanel">
       <h2>Task <span id="d_id"></span></h2>
       <table id="runs"><thead><tr>
-        <th>run</th><th>organization</th><th>status</th><th>result / log</th>
+        <th>run</th><th>organization</th><th>node</th><th>status</th>
+        <th>timing</th><th></th>
       </tr></thead><tbody></tbody></table>
+    </div>
+    <div class="panel hidden" id="runlogpanel">
+      <h2>Run <span id="rl_id"></span> <span id="rl_meta" class="who"></span></h2>
+      <h2>log</h2><pre id="rl_log"></pre>
+      <h2>result (serialized)</h2><pre id="rl_result"></pre>
     </div>
     </div><!-- /tab_overview -->
 
@@ -210,7 +221,7 @@ a guided form, or stay freeform">
     <div class="panel">
       <h2>Roles</h2>
       <table id="a_roles"><thead><tr>
-        <th>id</th><th>name</th><th>organization</th><th>rules</th>
+        <th>id</th><th>name</th><th>organization</th><th>rules</th><th></th>
       </tr></thead><tbody></tbody></table>
       <div class="row" style="margin-top:.6rem">
         <input id="r_name" placeholder="role name" size="16">
@@ -220,6 +231,30 @@ a guided form, or stay freeform">
         <button id="r_create">Create role</button>
       </div>
       <div id="roleerr" class="err"></div>
+    </div>
+    <div class="panel hidden" id="roledetail">
+      <h2>Role <span id="rd_name"></span></h2>
+      <table id="rd_rules"><thead><tr>
+        <th>rule</th><th>scope</th><th>operation</th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.6rem">
+        <select id="rd_edit_rules" multiple size="5"
+                title="replace this role's rules (ctrl-click)"></select>
+        <button id="rd_save">Save rules</button>
+        <button id="rd_delete" class="ghost">Delete role</button>
+        <span id="rd_msg" class="who"></span>
+      </div>
+      <div id="rd_err" class="err"></div>
+    </div>
+    <div class="panel hidden" id="userdetail">
+      <h2>User <span id="ud_name"></span></h2>
+      <div class="row">
+        <select id="ud_roles" multiple size="4"
+                title="replace this user's roles (ctrl-click)"></select>
+        <button id="ud_save">Save roles</button>
+        <span id="ud_msg" class="who"></span>
+      </div>
+      <div id="ud_err" class="err"></div>
     </div>
     <div class="panel">
       <h2>My account</h2>
@@ -238,10 +273,37 @@ a guided form, or stay freeform">
     <div id="tab_store" class="hidden">
     <div class="panel">
       <h2>Algorithm store <span id="s_url" class="who"></span></h2>
+      <div class="row" style="margin-bottom:.5rem">
+        <select id="s_status" title="which submissions to list">
+          <option value="">approved (public)</option>
+          <option value="submitted">submitted</option>
+          <option value="under review">under review</option>
+          <option value="rejected">rejected</option>
+        </select>
+      </div>
       <table id="s_algos"><thead><tr>
         <th>id</th><th>name</th><th>image</th><th>status</th><th>functions</th>
       </tr></thead><tbody></tbody></table>
       <div id="storeerr" class="err"></div>
+    </div>
+    <div class="panel">
+      <h2>Submit algorithm</h2>
+      <div class="row">
+        <input id="sa_name" placeholder="name" size="18">
+        <input id="sa_image" size="32"
+               placeholder="image ref, e.g. registry/algos/avg:1.0">
+      </div>
+      <div class="row" style="margin-top:.4rem">
+        <input id="sa_desc" placeholder="description" size="52">
+      </div>
+      <div class="row" style="margin-top:.4rem">
+        <textarea id="sa_functions" rows="4" cols="64" placeholder='functions JSON, e.g. [{"name":"partial_average","type":"federated","arguments":[{"name":"column","type":"column"}]}]'></textarea>
+      </div>
+      <div class="row" style="margin-top:.4rem">
+        <button id="sa_submit">Submit for review</button>
+        <span id="sa_msg" class="who"></span>
+      </div>
+      <div id="saerr" class="err"></div>
     </div>
     <div class="panel hidden" id="s_detailpanel">
       <h2>Algorithm <span id="s_d_name"></span></h2>
@@ -249,6 +311,16 @@ a guided form, or stay freeform">
       <table id="s_d_functions"><thead><tr>
         <th>function</th><th>type</th><th>arguments</th><th>databases</th>
       </tr></thead><tbody></tbody></table>
+      <h2 style="margin-top:.8rem">Reviews</h2>
+      <table id="s_d_reviews"><thead><tr>
+        <th>id</th><th>reviewer</th><th>status</th><th>comment</th><th></th>
+      </tr></thead><tbody></tbody></table>
+      <div class="row" style="margin-top:.5rem">
+        <button id="s_d_startreview" class="ghost">Start review (assign me)</button>
+        <input id="s_d_comment" placeholder="review comment" size="30">
+        <span id="s_d_msg" class="who"></span>
+      </div>
+      <div id="s_d_err" class="err"></div>
     </div>
     </div><!-- /tab_store -->
   </div>
@@ -381,14 +453,34 @@ $("t_collab").onchange = () => {
   refresh().catch(() => {});
 };
 
+let runCache = [];
 window.showTask = async function (id) {
   const runs = await api("GET", `task/${id}/run`);
+  runCache = runs.data;
   $("d_id").textContent = id;
   $("detailpanel").classList.remove("hidden");
+  const dur = (a, b) => (a && b) ? `${(b - a).toFixed(2)}s` : "—";
   fill("runs", runs.data, (r) =>
     `<tr><td>${Number(r.id)}</td><td>${esc(r.organization.id)}</td>` +
+    `<td>${esc(r.node && r.node.id ? r.node.id : "—")}</td>` +
     `<td>${badge(r.status)}</td>` +
-    `<td><pre>${esc((r.result || r.log || "").slice(0, 400))}</pre></td></tr>`);
+    `<td>queued ${dur(r.assigned_at, r.started_at)}, ` +
+    `ran ${dur(r.started_at, r.finished_at)}</td>` +
+    `<td><a onclick="showRunLog(${Number(r.id)})">log / result</a></td></tr>`);
+};
+
+// full-content run viewer (the table truncates nothing — it links here)
+window.showRunLog = function (id) {
+  const r = runCache.find((x) => x.id === id);
+  if (!r) return;
+  $("rl_id").textContent = id;
+  const ts = (t) => t ? new Date(t * 1000).toISOString() : "—";
+  $("rl_meta").textContent =
+    `org ${r.organization.id} · ${r.status} · assigned ${ts(r.assigned_at)}` +
+    ` · started ${ts(r.started_at)} · finished ${ts(r.finished_at)}`;
+  $("rl_log").textContent = r.log || "(empty)";
+  $("rl_result").textContent = r.result || "(no result)";
+  $("runlogpanel").classList.remove("hidden");
 };
 
 // ------------------------------------------------------------------- tabs
@@ -408,26 +500,32 @@ function switchTab(tab) {
 }
 
 // ------------------------------------------------------------------ admin
+let ruleCache = [], roleCache = [], userCache = [];
+
 async function refreshAdmin() {
   const [orgs, users, roles, rules] = await Promise.all([
     api("GET", "organization"), api("GET", "user"),
     api("GET", "role"), api("GET", "rule?per_page=500"),
   ]);
+  ruleCache = rules.data; roleCache = roles.data; userCache = users.data;
   fill("a_orgs", orgs.data, (o) =>
     `<tr><td>${Number(o.id)}</td><td>${esc(o.name)}</td>` +
     `<td>${esc(o.country || "")}</td>` +
     `<td>${o.public_key ? "yes" : "—"}</td></tr>`);
   const roleName = Object.fromEntries(roles.data.map((r) => [r.id, r.name]));
   fill("a_users", users.data, (u) =>
-    `<tr><td>${Number(u.id)}</td><td>${esc(u.username)}</td>` +
+    `<tr><td>${Number(u.id)}</td>` +
+    `<td><a onclick="showUser(${Number(u.id)})">${esc(u.username)}</a></td>` +
     `<td>${esc(u.email || "")}</td><td>${esc(u.organization.id)}</td>` +
     `<td>${esc((u.roles || []).map((r) => roleName[r] || r).join(", "))}</td>` +
     `<td><button class="ghost" onclick="deleteUser(${Number(u.id)})">` +
     `delete</button></td></tr>`);
   fill("a_roles", roles.data, (r) =>
-    `<tr><td>${Number(r.id)}</td><td>${esc(r.name)}</td>` +
+    `<tr><td>${Number(r.id)}</td>` +
+    `<td><a onclick="showRole(${Number(r.id)})">${esc(r.name)}</a></td>` +
     `<td>${esc(r.organization ? r.organization.id : "global")}</td>` +
-    `<td>${Number((r.rules || []).length)}</td></tr>`);
+    `<td>${Number((r.rules || []).length)}</td>` +
+    `<td><a onclick="showRole(${Number(r.id)})">manage</a></td></tr>`);
   const orgOpts = orgs.data.map(
     (o) => `<option value="${Number(o.id)}">${esc(o.name)}</option>`).join("");
   $("u_org").innerHTML = orgOpts;
@@ -442,6 +540,77 @@ async function refreshAdmin() {
 window.deleteUser = async function (id) {
   try { await api("DELETE", `user/${id}`); await refreshAdmin(); }
   catch (e) { $("usererr").textContent = e.message; }
+};
+
+// ------------------------------------------------- role & user management
+let shownRole = null, shownUser = null;
+
+window.showRole = function (id) {
+  const role = roleCache.find((r) => r.id === id);
+  if (!role) return;
+  shownRole = id;
+  $("rd_name").textContent =
+    `${role.name} (${role.organization ? "org " + role.organization.id
+                                       : "global"})`;
+  const ruleById = Object.fromEntries(ruleCache.map((r) => [r.id, r]));
+  fill("rd_rules", role.rules || [], (rid) => {
+    const r = ruleById[rid] || { name: rid, scope: "?", operation: "?" };
+    return `<tr><td>${esc(r.name)}</td><td>${esc(r.scope)}</td>` +
+      `<td>${esc(r.operation)}</td></tr>`;
+  });
+  const held = new Set(role.rules || []);
+  $("rd_edit_rules").innerHTML = ruleCache.map((r) =>
+    `<option value="${Number(r.id)}"${held.has(r.id) ? " selected" : ""}>` +
+    `${esc(r.name)}:${esc(r.scope)}:${esc(r.operation)}</option>`).join("");
+  $("rd_msg").textContent = ""; $("rd_err").textContent = "";
+  $("roledetail").classList.remove("hidden");
+};
+
+$("rd_save").onclick = async () => {
+  if (shownRole === null) return;
+  try {
+    $("rd_err").textContent = "";
+    await api("PATCH", `role/${shownRole}`,
+      { rules: selected("rd_edit_rules") });
+    $("rd_msg").textContent = "rules updated";
+    await refreshAdmin();
+    showRole(shownRole);
+  } catch (e) { $("rd_err").textContent = e.message; }
+};
+
+$("rd_delete").onclick = async () => {
+  if (shownRole === null) return;
+  try {
+    $("rd_err").textContent = "";
+    await api("DELETE", `role/${shownRole}`);
+    $("roledetail").classList.add("hidden");
+    shownRole = null;
+    await refreshAdmin();
+  } catch (e) { $("rd_err").textContent = e.message; }
+};
+
+window.showUser = function (id) {
+  const u = userCache.find((x) => x.id === id);
+  if (!u) return;
+  shownUser = id;
+  $("ud_name").textContent = `${u.username} (org ${u.organization.id})`;
+  const held = new Set(u.roles || []);
+  $("ud_roles").innerHTML = roleCache.map((r) =>
+    `<option value="${Number(r.id)}"${held.has(r.id) ? " selected" : ""}>` +
+    `${esc(r.name)}</option>`).join("");
+  $("ud_msg").textContent = ""; $("ud_err").textContent = "";
+  $("userdetail").classList.remove("hidden");
+};
+
+$("ud_save").onclick = async () => {
+  if (shownUser === null) return;
+  try {
+    $("ud_err").textContent = "";
+    await api("PATCH", `user/${shownUser}`,
+      { roles: selected("ud_roles") });
+    $("ud_msg").textContent = "roles updated";
+    await refreshAdmin();
+  } catch (e) { $("ud_err").textContent = e.message; }
 };
 
 const selected = (id) =>
@@ -507,7 +676,9 @@ async function refreshStore() {
   }
   $("s_url").textContent = info.url;
   try {
-    const algos = await api("GET", "store/algorithm");
+    const status = $("s_status").value;
+    const algos = await api("GET", "store/algorithm" +
+      (status ? `?status=${encodeURIComponent(status)}` : ""));
     storeAlgoCache = algos.data;
     fill("s_algos", algos.data, (a) =>
       `<tr><td><a onclick="showStoreAlgo(${Number(a.id)})">` +
@@ -517,13 +688,32 @@ async function refreshStore() {
       `</tr>`);
   } catch (e) { $("storeerr").textContent = e.message; }
 }
+$("s_status").onchange = () => refreshStore().catch(() => {});
 
-let storeAlgoCache = [];
-window.showStoreAlgo = function (id) {
+$("sa_submit").onclick = async () => {
+  try {
+    $("saerr").textContent = ""; $("sa_msg").textContent = "";
+    const fns = $("sa_functions").value.trim();
+    await api("POST", "store/algorithm", {
+      name: $("sa_name").value,
+      image: $("sa_image").value,
+      description: $("sa_desc").value,
+      functions: fns ? JSON.parse(fns) : [],
+    });
+    $("sa_msg").textContent = "submitted — awaiting review";
+    $("sa_name").value = ""; $("sa_image").value = "";
+    await refreshStore();
+  } catch (e) { $("saerr").textContent = e.message; }
+};
+
+let storeAlgoCache = [], shownStoreAlgo = null;
+window.showStoreAlgo = async function (id) {
   const a = storeAlgoCache.find((x) => x.id === id);
   if (!a) return;
+  shownStoreAlgo = id;
   $("s_d_name").textContent = `${a.name} (${a.image})`;
   $("s_d_desc").textContent = a.description || "";
+  $("s_d_msg").textContent = ""; $("s_d_err").textContent = "";
   $("s_detailpanel").classList.remove("hidden");
   fill("s_d_functions", a.functions || [], (f) =>
     `<tr><td>${esc(f.display_name || f.name)}</td><td>${esc(f.type)}</td>` +
@@ -531,6 +721,50 @@ window.showStoreAlgo = function (id) {
         `${x.name}:${x.type}${x.has_default ? "?" : ""}`).join(", "))}</td>` +
     `<td>${esc((f.databases || []).map((d) => d.name).join(", "))}</td>` +
     `</tr>`);
+  await refreshStoreReviews(id);
+};
+
+async function refreshStoreReviews(algoId) {
+  try {
+    const reviews = await api("GET", `store/review?algorithm_id=${algoId}`);
+    fill("s_d_reviews", reviews.data, (r) =>
+      `<tr><td>${Number(r.id)}</td><td>${esc(r.reviewer)}</td>` +
+      `<td>${badge(r.status)}</td><td>${esc(r.comment || "")}</td>` +
+      `<td>${r.status === "under review" ?
+        `<button onclick="decideReview(${Number(r.id)},'approved')">` +
+        `approve</button> ` +
+        `<button class="ghost" ` +
+        `onclick="decideReview(${Number(r.id)},'rejected')">reject</button>`
+        : ""}</td></tr>`);
+  } catch (e) {
+    // the review ledger needs a trusted-server token; browsing the public
+    // registry must keep working without it
+    fill("s_d_reviews", [], () => "");
+    $("s_d_err").textContent = e.message;
+  }
+}
+
+$("s_d_startreview").onclick = async () => {
+  if (shownStoreAlgo === null) return;
+  try {
+    $("s_d_err").textContent = "";
+    await api("POST", `store/algorithm/${shownStoreAlgo}/review`);
+    $("s_d_msg").textContent = "review opened — decide below";
+    await refreshStoreReviews(shownStoreAlgo);
+    await refreshStore();
+  } catch (e) { $("s_d_err").textContent = e.message; }
+};
+
+window.decideReview = async function (reviewId, verdict) {
+  try {
+    $("s_d_err").textContent = "";
+    await api("PATCH", `store/review/${reviewId}`, {
+      status: verdict, comment: $("s_d_comment").value,
+    });
+    $("s_d_msg").textContent = `review ${verdict}`;
+    await refreshStoreReviews(shownStoreAlgo);
+    await refreshStore();
+  } catch (e) { $("s_d_err").textContent = e.message; }
 };
 
 async function enter() {

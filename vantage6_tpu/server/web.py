@@ -15,7 +15,8 @@ import threading
 import traceback
 from typing import Any, Callable
 from urllib.parse import parse_qs
-from wsgiref.simple_server import WSGIRequestHandler, make_server
+from socketserver import ThreadingMixIn
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from vantage6_tpu.common.log import setup_logging
 
@@ -226,13 +227,27 @@ class _QuietHandler(WSGIRequestHandler):
         log.debug("%s %s", self.address_string(), fmt % args)
 
 
+class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+    """Thread-per-request WSGI server. REQUIRED, not an optimization: the
+    server's store proxy calls the store, which calls back into this same
+    server's /api/whoami for the trust handshake — on a serial server that
+    re-entrancy is a deadlock. The db layer keeps one sqlite connection per
+    thread for exactly this server model (server/db.py)."""
+
+    daemon_threads = True
+    # federation-scale accept queue: 32+ nodes polling plus a researcher
+    # burst overflows the wsgiref default of 5 and resets connections
+    request_queue_size = 128
+
+
 class AppServer:
     """Threaded HTTP server wrapper with background start/stop (used by the
     node daemon's proxy and by `v6t server start`)."""
 
     def __init__(self, app: App, host: str = "127.0.0.1", port: int = 0):
         self._server = make_server(
-            host, port, app, handler_class=_QuietHandler
+            host, port, app,
+            server_class=_ThreadingWSGIServer, handler_class=_QuietHandler,
         )
         self.host, self.port = self._server.server_address[:2]
         self._thread: threading.Thread | None = None
