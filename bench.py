@@ -386,9 +386,23 @@ def worker_transformer() -> None:
     # machine-wide (documented in .claude/skills/verify/SKILL.md). The
     # default TPU path is therefore `recompute` — flash-MEMORY attention in
     # plain XLA (blockwise forward + recompute backward, no [T, T]
-    # residuals) — with BENCH_FLASH=1 enabling the kernel on real
-    # (non-tunneled) TPU hardware.
-    want_flash = on_tpu and os.environ.get("BENCH_FLASH", "0") == "1"
+    # residuals) — with BENCH_FLASH=1 enabling the kernel. GRADUATION:
+    # once tools/flash_attempt.py has RECORDED a successful compiled-kernel
+    # execution on this hardware (FLASH_ATTEMPT.json result.ok), the kernel
+    # is proven safe here and becomes the default (BENCH_FLASH=0 still
+    # force-disables it).
+    flash_default = "0"
+    try:
+        with open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "FLASH_ATTEMPT.json"
+        )) as fh:
+            if json.load(fh).get("result", {}).get("ok"):
+                flash_default = "1"
+    except Exception:
+        pass
+    want_flash = on_tpu and os.environ.get(
+        "BENCH_FLASH", flash_default
+    ) == "1"
 
     def build(attention: str):
         cfg = FT.TransformerConfig(

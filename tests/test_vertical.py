@@ -1,0 +1,156 @@
+"""Vertical federated logistic regression: the federated fit must equal
+pooled full-batch GD on the column-concatenated design (the vertical
+analogue of the horizontal algorithms' identical-to-pooled keystone), and
+feature-axis padding must never leak."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vantage6_tpu.core.mesh import FederationMesh
+from vantage6_tpu.runtime.federation import federation_from_datasets
+from vantage6_tpu.workloads import vertical
+
+
+def _make(n=240, blocks=(3, 1, 2), seed=0, noise=0.8):
+    """Aligned vertical frames: same patients, disjoint feature blocks."""
+    rng = np.random.default_rng(seed)
+    p = sum(blocks)
+    x = rng.normal(size=(n, p)).astype(np.float64)
+    w_true = rng.normal(size=p)
+    y = (x @ w_true + noise * rng.normal(size=n) > 0).astype(np.float32)
+    frames, cols, at = [], [], 0
+    for s, width in enumerate(blocks):
+        c = [f"f{at + j}" for j in range(width)]
+        frames.append(pd.DataFrame(
+            {name: x[:, at + j] for j, name in enumerate(c)}
+        ))
+        cols.append(c)
+        at += width
+    frames[0]["outcome"] = y  # station 0 is the label party
+    return frames, cols, x, y
+
+
+def _pooled_gd(x, y, n_iter, lr, l2=0.0):
+    """Plain pooled full-batch GD — the maths both modes must reproduce."""
+    n, p = x.shape
+    w, b = np.zeros(p), 0.0
+    for _ in range(n_iter):
+        eta = x @ w + b
+        mu = 1.0 / (1.0 + np.exp(-eta))
+        r = mu - y
+        w = w - lr * (x.T @ r / n + l2 * w)
+        b = b - lr * float(np.mean(r))
+    return w, b
+
+
+class TestDeviceVertical:
+    def test_matches_pooled_gd(self, devices):
+        frames, cols, x, y = _make()
+        mesh = FederationMesh(len(frames))
+        sx, counts = vertical.stack_vertical_blocks(frames, cols)
+        out = vertical.fit_vertical_logistic_device(
+            mesh, jnp.asarray(sx), jnp.asarray(y), n_iter=60, lr=1.0
+        )
+        w_ref, b_ref = _pooled_gd(x, y, n_iter=60, lr=1.0)
+        # reassemble the concatenated weight vector from the blocks
+        w_fed = np.concatenate([
+            np.asarray(out["weights"][s][: counts[s]], np.float64)
+            for s in range(len(frames))
+        ])
+        np.testing.assert_allclose(w_fed, w_ref, atol=2e-4)
+        np.testing.assert_allclose(float(out["bias"]), b_ref, atol=2e-4)
+        # losses strictly improve over training
+        losses = np.asarray(out["losses"])
+        assert losses[-1] < losses[0]
+
+    def test_converges_to_mle_score_zero(self, devices):
+        frames, cols, x, y = _make(noise=1.5)
+        mesh = FederationMesh(len(frames))
+        sx, _ = vertical.stack_vertical_blocks(frames, cols)
+        out = vertical.fit_vertical_logistic_device(
+            mesh, jnp.asarray(sx), jnp.asarray(y), n_iter=800, lr=2.0
+        )
+        w_fed = np.concatenate([
+            np.asarray(out["weights"][s][: len(cols[s])], np.float64)
+            for s in range(len(frames))
+        ])
+        eta = x @ w_fed + float(out["bias"])
+        mu = 1.0 / (1.0 + np.exp(-eta))
+        score = x.T @ (y - mu) / len(y)  # MLE zeroes the pooled score
+        np.testing.assert_allclose(score, 0.0, atol=2e-3)
+
+    def test_feature_padding_never_leaks(self, devices):
+        frames, cols, x, y = _make(blocks=(4, 1, 2))
+        mesh = FederationMesh(len(frames))
+        sx, counts = vertical.stack_vertical_blocks(frames, cols)
+        assert sx.shape[-1] == 4  # widest block sets the pad
+        out = vertical.fit_vertical_logistic_device(
+            mesh, jnp.asarray(sx), jnp.asarray(y), n_iter=40, lr=1.0
+        )
+        # padded feature slots must remain EXACTLY zero after training
+        for s in range(len(frames)):
+            pad = np.asarray(out["weights"][s][counts[s]:])
+            np.testing.assert_array_equal(pad, 0.0)
+        # ...and widening the pad must not change the fit
+        sx2 = np.zeros((sx.shape[0], sx.shape[1], sx.shape[2] + 3),
+                       sx.dtype)
+        sx2[:, :, : sx.shape[2]] = sx
+        out2 = vertical.fit_vertical_logistic_device(
+            mesh, jnp.asarray(sx2), jnp.asarray(y), n_iter=40, lr=1.0
+        )
+        for s in range(len(frames)):
+            np.testing.assert_allclose(
+                np.asarray(out["weights"][s][: counts[s]]),
+                np.asarray(out2["weights"][s][: counts[s]]),
+                atol=1e-6,
+            )
+
+    def test_misaligned_rows_rejected(self):
+        frames, cols, _, _ = _make()
+        frames[1] = frames[1].iloc[:-5]
+        with pytest.raises(ValueError, match="align"):
+            vertical.stack_vertical_blocks(frames, cols)
+
+
+class TestHostVertical:
+    def test_host_rounds_match_device(self, devices):
+        frames, cols, x, y = _make(n=120, blocks=(2, 2), seed=3)
+        fed = federation_from_datasets(
+            frames, {"v6-vertical": vertical}
+        )
+        task = fed.create_task(
+            "v6-vertical",
+            {"method": "central_vertical_logistic", "kwargs": {
+                "feature_map": {str(s): cols[s] for s in range(len(cols))},
+                "label_org": 0,
+                "label_col": "outcome",
+                "n_iter": 25,
+                "lr": 1.0,
+            }},
+            organizations=[0],
+        )
+        host = fed.wait_for_results(task.id)[0]
+        w_ref, b_ref = _pooled_gd(x, y, n_iter=25, lr=1.0)
+        w_host = np.concatenate([
+            np.asarray(host["weights"][str(s)]) for s in range(len(cols))
+        ])
+        np.testing.assert_allclose(w_host, w_ref, atol=1e-10)
+        np.testing.assert_allclose(host["bias"], b_ref, atol=1e-10)
+        assert host["n"] == 120
+
+    def test_store_registration_as_vertical(self):
+        from vantage6_tpu.store.introspect import build_algorithm_spec
+
+        spec = build_algorithm_spec(
+            "vantage6_tpu.workloads.vertical",
+            name="vertical logistic regression",
+            image="v6t/algos/vertical-lr:1.0",
+            partitioning="vertical",
+        )
+        assert spec["partitioning"] == "vertical"
+        names = {f["name"] for f in spec["functions"]}
+        assert {"central_vertical_logistic", "partial_vertical_predictor",
+                "partial_vertical_grad", "partial_labels"} <= names

@@ -61,6 +61,7 @@ BUILTIN_ALGORITHMS = {
     "v6-correlation-py": "vantage6_tpu.workloads.stats",
     "v6-preprocess-py": "vantage6_tpu.workloads.preprocess",
     "v6-quantiles-py": "vantage6_tpu.workloads.quantiles",
+    "v6-vertical-lr-py": "vantage6_tpu.workloads.vertical",
     "v6-device-engine": "vantage6_tpu.workloads.device_engine",
 }
 

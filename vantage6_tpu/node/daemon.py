@@ -543,9 +543,15 @@ class NodeDaemon:
         The same sweep runs periodically WITHOUT orphan reclaim (see
         ``_sync_worker``) as anti-entropy against lost events — the claim
         set makes re-submission idempotent."""
-        statuses = [TaskStatus.PENDING]
-        if include_orphans:
-            statuses += [TaskStatus.INITIALIZING, TaskStatus.ACTIVE]
+        # Orphan statuses FIRST: were PENDING processed first, a run it
+        # just submitted could go ACTIVE in a worker thread and then be
+        # "reclaimed" (reset to pending mid-execution) by the pass that
+        # follows. The claimed-set guard below closes the rest of that
+        # window: anything this daemon currently owns is never an orphan.
+        statuses = (
+            [TaskStatus.INITIALIZING, TaskStatus.ACTIVE]
+            if include_orphans else []
+        ) + [TaskStatus.PENDING]
         for status in statuses:
             mutating = status is not TaskStatus.PENDING
             page = 1
@@ -566,14 +572,18 @@ class NodeDaemon:
                 progressed = 0
                 for run in body["data"]:
                     if mutating:
+                        with self._claim_lock:
+                            if run["id"] in self._claimed:
+                                continue  # executing in THIS daemon
                         try:
                             self.request(
                                 "PATCH",
                                 f"run/{run['id']}",
                                 {
                                     "status": TaskStatus.PENDING.value,
-                                    "log": "node restarted mid-run; "
-                                           "re-queued by startup sync",
+                                    "log": "orphaned mid-run (daemon "
+                                           "restart or lost report); "
+                                           "re-queued by sync",
                                 },
                             )
                         except Exception as e:
@@ -597,14 +607,16 @@ class NodeDaemon:
                 page += 1
 
     def _sync_worker(self) -> None:
-        """Periodic pending-run sweep (anti-entropy). Events remain the fast
-        path; this closes the gaps events cannot guarantee against — a hub
-        replay buffer overflow between polls, a dropped socket frame, or a
-        run whose first execution attempt failed before any status patch
-        (those are un-claimed on failure so the sweep can retry them)."""
+        """Periodic run sweep (anti-entropy). Events remain the fast path;
+        this closes the gaps events cannot guarantee against — a hub replay
+        buffer overflow between polls, a dropped socket frame, a run whose
+        first execution attempt failed before any status patch, or a run
+        whose TERMINAL patch was lost (finished work stuck ACTIVE at the
+        server). Orphan reclaim is safe mid-life because anything this
+        daemon currently executes is in the claim set and skipped."""
         while not self._stop.wait(self.sync_interval):
             try:
-                self._sync_missed_runs()
+                self._sync_missed_runs(include_orphans=True)
             except Exception as e:
                 log.warning("anti-entropy run sweep failed: %s", e)
 
