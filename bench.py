@@ -396,7 +396,10 @@ def worker_transformer() -> None:
         with open(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "FLASH_ATTEMPT.json"
         )) as fh:
-            if json.load(fh).get("result", {}).get("ok"):
+            rec = json.load(fh).get("result", {})
+            # the record must prove the kernel on TPU specifically — a CPU
+            # fallback attempt's ok=true must not arm the kernel here
+            if rec.get("ok") and rec.get("platform") == "tpu":
                 flash_default = "1"
     except Exception:
         pass
@@ -974,6 +977,25 @@ def main() -> None:
     else:
         out["flash_attempt"] = (
             "not yet attempted (tools/flash_attempt.py records it)"
+        )
+
+    # ---- recorded device-engine-on-chip attempt (same contract) --------
+    de = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "DEVICE_ENGINE_TPU.json")
+    if os.path.exists(de):
+        try:
+            with open(de) as fh:
+                rec = json.load(fh)
+            out["device_engine_tpu"] = {
+                "device_engine": rec.get("device_engine"),
+                "tunnel_before": rec.get("tunnel_before"),
+                "attempted_at": rec.get("attempted_at"),
+            }
+        except Exception as e:
+            out["device_engine_tpu"] = f"unreadable: {e}"
+    else:
+        out["device_engine_tpu"] = (
+            "not yet attempted (tools/device_engine_tpu.py records it)"
         )
 
     legs_done.append(leg_marker("fedoverhead", fo, fo_diag))

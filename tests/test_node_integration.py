@@ -440,3 +440,69 @@ def test_vpn_port_registration_roundtrip(stack, monkeypatch):
         assert ports[0]["label"] == "vpn"
     finally:
         daemon.stop()
+
+
+def test_anti_entropy_sweep_recovers_lost_terminal_report(stack, tmp_path):
+    """A run stuck ACTIVE at the server (its terminal report was lost) is
+    reclaimed by the daemon's periodic sweep WITHOUT a restart — and a run
+    currently executing is never touched (claim-set guard)."""
+    import numpy as np
+    import pandas as pd
+
+    client = stack["client"]
+    org = client.organization.create(name="sweep_org")
+    collab = client.collaboration.create(
+        name="sweep_collab", organization_ids=[org["id"]]
+    )
+    csv = tmp_path / "sweep.csv"
+    pd.DataFrame({"age": np.arange(30.0)}).to_csv(csv, index=False)
+    node_info = client.node.create(
+        organization_id=org["id"], collaboration_id=collab["id"]
+    )
+    daemon = NodeDaemon(
+        api_url=stack["http"].url,
+        api_key=node_info["api_key"],
+        algorithms={"v6-average-py": "vantage6_tpu.workloads.average"},
+        databases=[{"label": "default", "type": "csv", "uri": str(csv)}],
+        mode="inline",
+        poll_interval=0.05,
+        sync_interval=0.5,
+    )
+    daemon.start()
+    try:
+        task = client.task.create(
+            collaboration=collab["id"],
+            organizations=[org["id"]],
+            image="v6-average-py",
+            input_={"method": "partial_average", "kwargs": {"column": "age"}},
+        )
+        client.wait_for_results(task["id"], interval=0.05, timeout=30)
+        run = client.run.from_task(task["id"])[0]
+        # simulate a lost terminal report: force the COMPLETED run back to
+        # ACTIVE server-side, as if the daemon's final PATCH never arrived
+        from vantage6_tpu.server import models as m
+
+        row = m.TaskRun.get(run["id"])
+        row.status = "active"
+        row.result = None
+        row.finished_at = None
+        row.save()
+        # the daemon must NOT still hold the claim (successful runs keep
+        # their claim for the daemon's life) — drop it to model "previous
+        # attempt is truly gone", which is what a lost report means
+        daemon._unclaim(run["id"])
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            got = client.run.from_task(task["id"])[0]
+            if got["status"] == "completed" and got["result"]:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"sweep never recovered the orphaned run: {got['status']}"
+            )
+        # the re-executed result is the same answer
+        results = client.wait_for_results(task["id"], timeout=10)
+        assert results[0]["count"] == 30
+    finally:
+        daemon.stop()

@@ -1,0 +1,190 @@
+"""One `engine="device"` task against the REAL chip (VERDICT r4 next #3).
+
+The device-engine bridge is Gloo-proven on 2 CPU processes
+(tests/test_device_engine_mp.py); this tool proves the OTHER leg — a
+single daemon whose device engine runs on the real TPU backend: server +
+UserClient + NodeDaemon(device_engine={}) in one process, one
+`task.create(engine="device", method="device_column_stats")`, the result
+computed by the jitted collective program on the chip. Outcome (including
+platform/device_kind as seen by the daemon) is written to
+DEVICE_ENGINE_TPU.json at the repo root; bench.py does NOT run this —
+like tools/flash_attempt.py it is run deliberately, because any TPU touch
+over a wedged axon tunnel hangs the process.
+
+Guard structure mirrors flash_attempt.py: pre-probe (distinguish "bridge
+failed" from "tunnel was already dead"), the whole stack in a sacrificial
+child subprocess with a hard timeout, post-probe to record tunnel damage.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+ARTIFACT = REPO / "DEVICE_ENGINE_TPU.json"
+CHILD_TIMEOUT_S = 420  # TPU init + first compile 20-40s each; generous
+PROBE_TIMEOUT_S = 120
+
+
+def child() -> None:
+    import numpy as np
+    import pandas as pd
+
+    sys.path.insert(0, str(REPO))
+    import tempfile
+
+    import jax
+
+    from vantage6_tpu.client import UserClient
+    from vantage6_tpu.node.daemon import NodeDaemon
+    from vantage6_tpu.server.app import ServerApp
+
+    t0 = time.perf_counter()
+    platform = jax.devices()[0].platform
+    device_kind = jax.devices()[0].device_kind
+    init_s = time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp()
+    rng = np.random.default_rng(7)
+    vals = rng.uniform(20, 80, 500).round(1)
+    pd.DataFrame({"age": vals}).to_csv(f"{tmp}/s0.csv", index=False)
+
+    srv = ServerApp()
+    srv.ensure_root(password="rootpass123")
+    http = srv.serve(port=0, background=True)
+    client = UserClient(http.url)
+    client.authenticate("root", "rootpass123")
+    org = client.organization.create(name="tpu_org")
+    collab = client.collaboration.create(
+        name="tpu", organization_ids=[org["id"]]
+    )
+    node_info = client.node.create(
+        organization_id=org["id"], collaboration_id=collab["id"]
+    )
+    daemon = NodeDaemon(
+        api_url=http.url,
+        api_key=node_info["api_key"],
+        algorithms={"device-engine": "vantage6_tpu.workloads.device_engine"},
+        databases=[
+            {"label": "default", "type": "csv", "uri": f"{tmp}/s0.csv"}
+        ],
+        mode="inline",
+        poll_interval=0.1,
+        device_engine={},  # local devices only: THE one real chip
+    )
+    daemon.start()
+    t0 = time.perf_counter()
+    task = client.task.create(
+        collaboration=collab["id"],
+        organizations=[org["id"]],
+        image="device-engine",
+        input_={
+            "method": "device_column_stats",
+            "kwargs": {"column": "age", "pad_to": 512},
+        },
+        databases=[{"label": "default"}],
+        engine="device",
+    )
+    result = client.wait_for_results(
+        task_id=task["id"], interval=0.2, timeout=CHILD_TIMEOUT_S - 60
+    )[0]
+    task_s = time.perf_counter() - t0
+    daemon.stop()
+    http.stop()
+    srv.close()
+
+    ok = (
+        abs(result["mean"] - float(vals.mean())) < 1e-3
+        and abs(result["std"] - float(vals.std())) < 1e-3
+        and result["count"] == len(vals)
+    )
+    print(json.dumps({
+        "ok": bool(ok),
+        "platform": platform,
+        "device_kind": device_kind,
+        "tpu_init_seconds": round(init_s, 1),
+        "task_seconds": round(task_s, 1),
+        "result": result,
+        "expected": {
+            "mean": float(vals.mean()),
+            "std": float(vals.std()),
+            "count": len(vals),
+        },
+    }))
+
+
+def probe() -> str:
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
+        "jax.block_until_ready(x);"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        if p.returncode == 0:
+            return f"alive ({p.stdout.strip()})"
+        return f"broken (exit {p.returncode}): {p.stderr[-300:]}"
+    except subprocess.TimeoutExpired:
+        return f"WEDGED (probe hung > {PROBE_TIMEOUT_S}s)"
+
+
+def main() -> None:
+    started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    outcome: dict = {"attempted_at": started,
+                     "child_timeout_s": CHILD_TIMEOUT_S}
+    outcome["tunnel_before"] = probe()
+    if not outcome["tunnel_before"].startswith("alive"):
+        outcome["device_engine"] = (
+            "blocked: tunnel unhealthy BEFORE the attempt "
+            f"({outcome['tunnel_before']}); the bridge was never reached — "
+            "re-run when the tunnel recovers"
+        )
+        ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
+        print(json.dumps(outcome))
+        return
+    try:
+        p = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()), "--child"],
+            capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+            env={**os.environ},
+        )
+        if p.returncode == 0 and p.stdout.strip():
+            for line in reversed(p.stdout.strip().splitlines()):
+                try:
+                    outcome["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            r = outcome.get("result") or {}
+            outcome["device_engine"] = (
+                f"ok: device_column_stats on {r.get('platform')} "
+                f"({r.get('device_kind')}) in {r.get('task_seconds')}s"
+                if r.get("ok") else f"ran but wrong: {r}"
+            )
+        else:
+            outcome["device_engine"] = (
+                f"child exited {p.returncode}: {(p.stderr or p.stdout)[-600:]}"
+            )
+    except subprocess.TimeoutExpired:
+        outcome["device_engine"] = (
+            f"HUNG: the stack did not complete within {CHILD_TIMEOUT_S}s; "
+            "child killed"
+        )
+    outcome["tunnel_after"] = probe()
+    ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
+    print(json.dumps(outcome))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child()
+    else:
+        main()

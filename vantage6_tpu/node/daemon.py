@@ -222,7 +222,7 @@ class NodeDaemon:
         self._cursor = self.request("GET", "event", params={"since": 0})[
             "cursor"
         ]
-        self._sync_missed_runs(include_orphans=True)
+        self._sync_missed_runs()
         self._reconcile_sessions()
         self._sync_thread = threading.Thread(
             target=self._sync_worker, daemon=True, name="v6t-sync"
@@ -530,36 +530,37 @@ class NodeDaemon:
                 return out
             page += 1
 
-    def _sync_missed_runs(self, include_orphans: bool = False) -> None:
-        """Reference: sync_task_queue_with_server — execute runs queued
-        while the node was offline. Server-side status filter + full page
-        drain: pending work must never hide behind page 1 of history.
+    def _sync_missed_runs(self) -> None:
+        """Reference: sync_task_queue_with_server — reclaim every run this
+        node owes an execution. Runs at start AND periodically
+        (``_sync_worker``); the claim set makes it idempotent and safe
+        mid-life:
 
-        With ``include_orphans`` (restart time only), runs this node left
-        INITIALIZING/ACTIVE in a previous daemon life are reset to pending
-        on the server and re-executed: this daemon is the ONLY executor its
-        runs will ever have, so anything non-terminal it does not currently
-        own is orphaned by definition (the claim set is empty at start).
-        The same sweep runs periodically WITHOUT orphan reclaim (see
-        ``_sync_worker``) as anti-entropy against lost events — the claim
-        set makes re-submission idempotent."""
+        - PENDING runs (queued while offline, or whose event was lost) are
+          simply (re-)submitted — `_submit` dedupes via the claim set;
+        - INITIALIZING/ACTIVE runs NOT in the claim set are orphans —
+          left by a previous daemon life, or finished work whose terminal
+          report was lost — and are reset to pending server-side, then
+          re-executed. Anything this daemon is currently executing IS in
+          the claim set and is never touched; that guard (not "the claim
+          set is empty at start") is what makes mid-life reclaim sound.
+        """
         # Orphan statuses FIRST: were PENDING processed first, a run it
         # just submitted could go ACTIVE in a worker thread and then be
         # "reclaimed" (reset to pending mid-execution) by the pass that
         # follows. The claimed-set guard below closes the rest of that
-        # window: anything this daemon currently owns is never an orphan.
-        statuses = (
-            [TaskStatus.INITIALIZING, TaskStatus.ACTIVE]
-            if include_orphans else []
-        ) + [TaskStatus.PENDING]
-        for status in statuses:
+        # window.
+        for status in (TaskStatus.INITIALIZING, TaskStatus.ACTIVE,
+                       TaskStatus.PENDING):
             mutating = status is not TaskStatus.PENDING
             page = 1
             while True:
                 # the orphan pass MUTATES the filtered set (each PATCH
-                # removes a run from this status), so it must re-fetch page
-                # 1 until the set drains — incrementing the page would skip
-                # everything the shrinkage slid onto page 1
+                # removes a run from this status), so after any progress it
+                # re-fetches page 1 — incrementing the page would skip
+                # everything the shrinkage slid onto page 1. A page of
+                # only claimed (still-executing) runs advances the page
+                # instead: reclaimable orphans behind it must not starve.
                 body = self.request(
                     "GET",
                     "run",
@@ -569,12 +570,13 @@ class NodeDaemon:
                         "page": page,
                     },
                 )
-                progressed = 0
+                progressed = skipped = 0
                 for run in body["data"]:
                     if mutating:
                         with self._claim_lock:
                             if run["id"] in self._claimed:
-                                continue  # executing in THIS daemon
+                                skipped += 1  # executing in THIS daemon
+                                continue
                         try:
                             self.request(
                                 "PATCH",
@@ -598,9 +600,13 @@ class NodeDaemon:
                 if not body["data"]:
                     break
                 if mutating:
-                    if progressed == 0:
-                        break  # nothing transitioned: avoid spinning
-                    continue  # re-fetch page 1 of the shrunken set
+                    if progressed > 0:
+                        page = 1       # set shrank: start over
+                    elif skipped > 0:
+                        page += 1      # page was all claimed: look deeper
+                    else:
+                        break          # only PATCH failures left: no spin
+                    continue
                 total = body.get("pagination", {}).get("total", 0)
                 if page * 250 >= total:
                     break
@@ -616,7 +622,7 @@ class NodeDaemon:
         daemon currently executes is in the claim set and skipped."""
         while not self._stop.wait(self.sync_interval):
             try:
-                self._sync_missed_runs(include_orphans=True)
+                self._sync_missed_runs()
             except Exception as e:
                 log.warning("anti-entropy run sweep failed: %s", e)
 
