@@ -989,6 +989,7 @@ def main() -> None:
             out["device_engine_tpu"] = {
                 "device_engine": rec.get("device_engine"),
                 "tunnel_before": rec.get("tunnel_before"),
+                "tunnel_after": rec.get("tunnel_after"),
                 "attempted_at": rec.get("attempted_at"),
             }
         except Exception as e:

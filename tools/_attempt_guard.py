@@ -1,0 +1,103 @@
+"""Shared guard harness for one-shot TPU attempts (flash kernel,
+device-engine bridge): pre-probe the tunnel, run the attempt in a
+SACRIFICIAL child subprocess under a hard timeout, post-probe to record
+any damage, write the artifact. One implementation so probe semantics,
+stdout parsing and timeout handling cannot drift between tools.
+
+Why this structure: any TPU touch over a wedged axon tunnel hangs the
+process indefinitely (documented in .claude/skills/verify/SKILL.md), so
+the attempt must be disposable and the evidence must be written by the
+parent either way.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Callable
+
+PROBE_TIMEOUT_S = 120
+
+
+def probe(timeout_s: float = PROBE_TIMEOUT_S) -> str:
+    """Tunnel health. Healthy results START with 'alive' — check with
+    startswith, never a substring (error text can contain 'alive')."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
+        "jax.block_until_ready(x);"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if p.returncode == 0:
+            return f"alive ({p.stdout.strip()})"
+        return f"broken (exit {p.returncode}): {p.stderr[-300:]}"
+    except subprocess.TimeoutExpired:
+        return f"WEDGED (probe hung > {timeout_s:.0f}s)"
+
+
+def run_guarded(
+    *,
+    tool_file: str,
+    artifact: Path,
+    key: str,
+    child_timeout_s: float,
+    describe: Callable[[dict], str],
+    what: str,
+) -> dict:
+    """The guard flow shared by every attempt tool.
+
+    ``tool_file`` is re-invoked with ``--child`` as the sacrificial
+    subprocess; its LAST valid JSON stdout line becomes ``result``.
+    ``describe(result)`` renders the one-line outcome stored under
+    ``key``; ``what`` names the thing never reached when blocked.
+    Returns the outcome dict (also written to ``artifact``).
+    """
+    outcome: dict = {
+        "attempted_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "child_timeout_s": child_timeout_s,
+    }
+    outcome["tunnel_before"] = probe()
+    if not outcome["tunnel_before"].startswith("alive"):
+        outcome[key] = (
+            "blocked: tunnel unhealthy BEFORE the attempt "
+            f"({outcome['tunnel_before']}); {what} was never reached — "
+            "re-run when the tunnel recovers"
+        )
+        artifact.write_text(json.dumps(outcome, indent=1) + "\n")
+        print(json.dumps(outcome))
+        return outcome
+    try:
+        p = subprocess.run(
+            [sys.executable, str(Path(tool_file).resolve()), "--child"],
+            capture_output=True, text=True, timeout=child_timeout_s,
+            env={**os.environ},
+        )
+        if p.returncode == 0 and p.stdout.strip():
+            for line in reversed(p.stdout.strip().splitlines()):
+                try:
+                    outcome["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            outcome[key] = describe(outcome.get("result") or {})
+        else:
+            outcome[key] = (
+                f"child exited {p.returncode}: {(p.stderr or p.stdout)[-600:]}"
+            )
+    except subprocess.TimeoutExpired:
+        outcome[key] = (
+            f"HUNG: {what} did not complete within {child_timeout_s:.0f}s; "
+            "child killed"
+        )
+    outcome["tunnel_after"] = probe()
+    artifact.write_text(json.dumps(outcome, indent=1) + "\n")
+    print(json.dumps(outcome))
+    return outcome

@@ -11,15 +11,14 @@ DEVICE_ENGINE_TPU.json at the repo root; bench.py does NOT run this —
 like tools/flash_attempt.py it is run deliberately, because any TPU touch
 over a wedged axon tunnel hangs the process.
 
-Guard structure mirrors flash_attempt.py: pre-probe (distinguish "bridge
-failed" from "tunnel was already dead"), the whole stack in a sacrificial
-child subprocess with a hard timeout, post-probe to record tunnel damage.
+Guard structure is shared with flash_attempt.py (tools/_attempt_guard.py):
+pre-probe (distinguish "bridge failed" from "tunnel was already dead"),
+the whole stack in a sacrificial child subprocess with a hard timeout,
+post-probe to record tunnel damage.
 """
 from __future__ import annotations
 
 import json
-import os
-import subprocess
 import sys
 import time
 from pathlib import Path
@@ -27,7 +26,6 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 ARTIFACT = REPO / "DEVICE_ENGINE_TPU.json"
 CHILD_TIMEOUT_S = 420  # TPU init + first compile 20-40s each; generous
-PROBE_TIMEOUT_S = 120
 
 
 def child() -> None:
@@ -117,70 +115,22 @@ def child() -> None:
     }))
 
 
-def probe() -> str:
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
-        "jax.block_until_ready(x);"
-        "print(jax.devices()[0].platform)"
-    )
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
-        if p.returncode == 0:
-            return f"alive ({p.stdout.strip()})"
-        return f"broken (exit {p.returncode}): {p.stderr[-300:]}"
-    except subprocess.TimeoutExpired:
-        return f"WEDGED (probe hung > {PROBE_TIMEOUT_S}s)"
-
-
 def main() -> None:
-    started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    outcome: dict = {"attempted_at": started,
-                     "child_timeout_s": CHILD_TIMEOUT_S}
-    outcome["tunnel_before"] = probe()
-    if not outcome["tunnel_before"].startswith("alive"):
-        outcome["device_engine"] = (
-            "blocked: tunnel unhealthy BEFORE the attempt "
-            f"({outcome['tunnel_before']}); the bridge was never reached — "
-            "re-run when the tunnel recovers"
-        )
-        ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
-        print(json.dumps(outcome))
-        return
-    try:
-        p = subprocess.run(
-            [sys.executable, str(Path(__file__).resolve()), "--child"],
-            capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
-            env={**os.environ},
-        )
-        if p.returncode == 0 and p.stdout.strip():
-            for line in reversed(p.stdout.strip().splitlines()):
-                try:
-                    outcome["result"] = json.loads(line)
-                    break
-                except json.JSONDecodeError:
-                    continue
-            r = outcome.get("result") or {}
-            outcome["device_engine"] = (
-                f"ok: device_column_stats on {r.get('platform')} "
-                f"({r.get('device_kind')}) in {r.get('task_seconds')}s"
-                if r.get("ok") else f"ran but wrong: {r}"
-            )
-        else:
-            outcome["device_engine"] = (
-                f"child exited {p.returncode}: {(p.stderr or p.stdout)[-600:]}"
-            )
-    except subprocess.TimeoutExpired:
-        outcome["device_engine"] = (
-            f"HUNG: the stack did not complete within {CHILD_TIMEOUT_S}s; "
-            "child killed"
-        )
-    outcome["tunnel_after"] = probe()
-    ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
-    print(json.dumps(outcome))
+    sys.path.insert(0, str(REPO / "tools"))
+    from _attempt_guard import run_guarded
+
+    run_guarded(
+        tool_file=__file__,
+        artifact=ARTIFACT,
+        key="device_engine",
+        child_timeout_s=CHILD_TIMEOUT_S,
+        what="the bridge",
+        describe=lambda r: (
+            f"ok: device_column_stats on {r.get('platform')} "
+            f"({r.get('device_kind')}) in {r.get('task_seconds')}s"
+            if r.get("ok") else f"ran but wrong: {r}"
+        ),
+    )
 
 
 if __name__ == "__main__":

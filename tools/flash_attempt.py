@@ -9,17 +9,16 @@ evidence either way, without booby-trapping routine benches:
 - ``python tools/flash_attempt.py --child`` is the sacrificial subprocess:
   it compiles and executes the kernel on the default (TPU) backend and
   prints one JSON line with numerics-vs-reference and timing.
-- ``python tools/flash_attempt.py`` is the guard: runs the child under a
-  hard timeout, kills it on hang, probes tunnel health afterwards, and
-  writes the outcome to FLASH_ATTEMPT.json at the repo root. bench.py
+- ``python tools/flash_attempt.py`` is the guard (shared harness:
+  tools/_attempt_guard.py): runs the child under a hard timeout, kills it
+  on hang, probes tunnel health before and after, and writes the outcome
+  to FLASH_ATTEMPT.json at the repo root. bench.py
   folds that artifact into its output so the driver's BENCH_r{N}.json
   carries the recorded outcome.
 """
 from __future__ import annotations
 
 import json
-import os
-import subprocess
 import sys
 import time
 from pathlib import Path
@@ -27,7 +26,6 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 ARTIFACT = REPO / "FLASH_ATTEMPT.json"
 CHILD_TIMEOUT_S = 300  # first TPU compile is 20-40s; 5 min is generous
-PROBE_TIMEOUT_S = 120
 
 
 def child() -> None:
@@ -69,69 +67,22 @@ def child() -> None:
     }))
 
 
-def probe() -> str:
-    """Tunnel health (run BEFORE the attempt to distinguish 'kernel hung'
-    from 'tunnel was already dead', and AFTER to record the damage).
-    Healthy results START with 'alive' — check with startswith, never a
-    substring (error text can contain 'alive', e.g. 'keepalive')."""
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
-        "jax.block_until_ready(x);"
-        "print(jax.devices()[0].platform)"
-    )
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
-        if p.returncode == 0:
-            return f"alive ({p.stdout.strip()})"
-        return f"broken (exit {p.returncode}): {p.stderr[-300:]}"
-    except subprocess.TimeoutExpired:
-        return f"WEDGED (probe hung > {PROBE_TIMEOUT_S}s)"
-
-
 def main() -> None:
-    started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    outcome: dict = {"attempted_at": started, "child_timeout_s": CHILD_TIMEOUT_S}
-    # pre-probe: a tunnel that is ALREADY wedged would make a child hang
-    # look like a kernel failure — record the distinction
-    outcome["tunnel_before"] = probe()
-    if not outcome["tunnel_before"].startswith("alive"):
-        outcome["flash"] = (
-            "blocked: tunnel unhealthy BEFORE the attempt "
-            f"({outcome['tunnel_before']}); the kernel was never reached — "
-            "re-run when the tunnel recovers"
-        )
-        ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
-        print(json.dumps(outcome))
-        return
-    try:
-        p = subprocess.run(
-            [sys.executable, str(Path(__file__).resolve()), "--child"],
-            capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
-            env={**os.environ},
-        )
-        if p.returncode == 0 and p.stdout.strip():
-            outcome["result"] = json.loads(p.stdout.strip().splitlines()[-1])
-            r = outcome["result"]
-            outcome["flash"] = (
-                f"ok: {r['exec_ms']} ms, max err {r['max_abs_err_vs_reference']}"
-                if r["ok"] else f"numerics mismatch: {r}"
-            )
-        else:
-            outcome["flash"] = (
-                f"child exited {p.returncode}: {(p.stderr or p.stdout)[-500:]}"
-            )
-    except subprocess.TimeoutExpired:
-        outcome["flash"] = (
-            f"HUNG: compiled pallas_call did not complete within "
-            f"{CHILD_TIMEOUT_S}s; child killed"
-        )
-    outcome["tunnel_after"] = probe()
-    ARTIFACT.write_text(json.dumps(outcome, indent=1) + "\n")
-    print(json.dumps(outcome))
+    sys.path.insert(0, str(REPO / "tools"))
+    from _attempt_guard import run_guarded
+
+    run_guarded(
+        tool_file=__file__,
+        artifact=ARTIFACT,
+        key="flash",
+        child_timeout_s=CHILD_TIMEOUT_S,
+        what="the kernel",
+        describe=lambda r: (
+            f"ok: {r.get('exec_ms')} ms, max err "
+            f"{r.get('max_abs_err_vs_reference')}"
+            if r.get("ok") else f"numerics mismatch: {r}"
+        ),
+    )
 
 
 if __name__ == "__main__":

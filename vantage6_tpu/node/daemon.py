@@ -94,6 +94,9 @@ class NodeDaemon:
         )
         self._claimed: set[int] = set()
         self._claim_lock = threading.Lock()
+        # one sweep at a time: the sync worker and a post-restart resync
+        # must not interleave their claim-check -> PATCH windows
+        self._sync_lock = threading.Lock()
         # device-engine runs execute on a DEDICATED single worker in
         # ascending task-id order: collective SPMD programs must enter in
         # the same globally agreed order on every member daemon, or two
@@ -192,17 +195,34 @@ class NodeDaemon:
         return self._rest.request(method, endpoint, json_body, params)
 
     def _refresh(self) -> bool:
-        if not self._refresh_token:
-            return False
+        if self._refresh_token:
+            try:
+                data = RestSession(self.api_url).request(
+                    "POST", "token/refresh",
+                    {"refresh_token": self._refresh_token},
+                )
+                self._access_token = data["access_token"]
+                self._refresh_token = data.get(
+                    "refresh_token", self._refresh_token
+                )
+                return True
+            except RestError:
+                pass
+        # refresh rejected: the server may have RESTARTED with a fresh JWT
+        # secret (no configured jwt_secret). The api_key is the node's
+        # durable credential — re-authenticate from scratch so a server
+        # bounce never bricks a running daemon.
         try:
-            data = RestSession(self.api_url).request(
-                "POST", "token/refresh",
-                {"refresh_token": self._refresh_token},
+            data = self._post_raw(
+                "token/node", {"api_key": self.api_key}, auth=False
             )
-        except RestError:
+        except Exception as e:
+            log.warning("node re-authentication failed: %s", e)
             return False
         self._access_token = data["access_token"]
-        self._refresh_token = data.get("refresh_token", self._refresh_token)
+        self._refresh_token = data["refresh_token"]
+        log.info("re-authenticated with api_key (refresh token rejected — "
+                 "server restart?)")
         return True
 
     def _register_public_key(self) -> None:
@@ -293,7 +313,31 @@ class NodeDaemon:
             log.warning("event poll failed: %s", e)
             self._stop.wait(self.poll_interval * 4)
             return
-        self._cursor = max(self._cursor, batch["cursor"])
+        if batch["cursor"] < self._cursor:
+            # the hub's sequence counter runs BEHIND our watermark: the
+            # server restarted (in-memory hub, fresh counter). Keeping the
+            # old watermark would filter out every future event forever.
+            # Adopt the new sequence space and resync EVERYTHING an event
+            # could have carried: queued runs, kills (a missed kill-task
+            # would let a killed run execute to completion), and deleted
+            # sessions (a missed session-deleted leaves extracted
+            # dataframes on disk) — runs have the periodic sweep as
+            # backstop, kills and sessions only have this.
+            log.info(
+                "event cursor regressed %s -> %s (server restart); "
+                "resyncing runs/kills/sessions", self._cursor,
+                batch["cursor"],
+            )
+            self._cursor = batch["cursor"]
+            for heal in (self._sync_missed_runs, self._sync_kills,
+                         self._reconcile_sessions):
+                try:
+                    heal()
+                except Exception as e:
+                    log.warning("post-restart %s failed: %s",
+                                heal.__name__, e)
+        else:
+            self._cursor = max(self._cursor, batch["cursor"])
         for event in batch["data"]:
             self._handle(event)
 
@@ -544,7 +588,25 @@ class NodeDaemon:
           re-executed. Anything this daemon is currently executing IS in
           the claim set and is never touched; that guard (not "the claim
           set is empty at start") is what makes mid-life reclaim sound.
+
+        Serialized by ``_sync_lock``: the periodic sweep and a
+        post-restart resync must not interleave claim-check -> PATCH.
         """
+        with self._sync_lock:
+            self._sync_missed_runs_locked()
+
+    def _sync_kills(self) -> None:
+        """Re-learn kills this node may have missed (post-restart heal):
+        the kill-task EVENT is the only push channel, so after a cursor
+        reset the killed set is rebuilt from the server's run statuses."""
+        body = self.request(
+            "GET", "run",
+            params={"status": TaskStatus.KILLED.value, "per_page": 250},
+        )
+        for run in body["data"]:
+            self._killed.add(run["id"])
+
+    def _sync_missed_runs_locked(self) -> None:
         # Orphan statuses FIRST: were PENDING processed first, a run it
         # just submitted could go ACTIVE in a worker thread and then be
         # "reclaimed" (reset to pending mid-execution) by the pass that
