@@ -235,3 +235,82 @@ class TestVPNManager:
 
     def test_setup_reports_unsupported_transport(self):
         assert VPNManager(enabled=True).setup() is False
+
+
+class TestWhitelistCIDRSemantics:
+    """Round-5 depth: squid-parity dst semantics — CIDR networks for IP
+    literals, and domain globs that can NEVER match a raw IP."""
+
+    def test_cidr_entries(self):
+        wl = OutboundWhitelist(enabled=True, ips=["10.0.0.0/8"])
+        assert wl.allows("http://10.200.3.4/x")
+        assert not wl.allows("http://11.0.0.1/x")
+
+    def test_exact_ip_entry(self):
+        wl = OutboundWhitelist(enabled=True, ips=["192.168.7.9"])
+        assert wl.allows("http://192.168.7.9:80/x")
+        assert not wl.allows("http://192.168.7.10/x")
+
+    def test_domain_glob_never_matches_raw_ip(self):
+        # squid: dstdomain acls do not match literal-IP requests — a
+        # permissive hostname glob must not leak IP egress
+        wl = OutboundWhitelist(enabled=True, domains=["1*"])
+        assert not wl.allows("http://10.0.0.1/x")
+
+    def test_ip_glob_fallback_still_works(self):
+        wl = OutboundWhitelist(enabled=True, ips=["10.0.0.*"])
+        assert wl.allows("http://10.0.0.7/x")
+        assert not wl.allows("http://10.0.1.7/x")
+
+    def test_ipv6_literal(self):
+        wl = OutboundWhitelist(enabled=True, ips=["2001:db8::/32"])
+        assert wl.allows("http://[2001:db8::1]:8080/x")
+        assert not wl.allows("http://[2001:db9::1]/x")
+
+
+class TestConfigValidation:
+    def test_bad_vpn_subnet_fails_at_construction(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="subnet"):
+            VPNManager(enabled=True, subnet="10.76.0.0/99")
+
+    def test_out_of_range_exposed_port_dropped(self):
+        vpn = VPNManager()
+        assert vpn.exposed_ports({"ports": "80,70000,443"}) == [80, 443]
+
+    def test_ssh_tunnel_shape_validation(self):
+        import pytest
+
+        from vantage6_tpu.node.gates import SSHTunnelManager
+
+        ok = SSHTunnelManager.from_config([{
+            "hostname": "warehouse",
+            "ssh": {"host": "internal.host", "port": 22},
+            "tunnel": {"bind": {"ip": "0.0.0.0", "port": 5432},
+                       "dest": {"ip": "10.0.0.5", "port": 5432}},
+            "local_uri": "postgresql://localhost:5432/db",
+        }])
+        assert ok.endpoint("warehouse")["local_uri"].startswith("postgresql")
+        with pytest.raises(ValueError, match="ssh block needs host"):
+            SSHTunnelManager.from_config(
+                [{"hostname": "t", "ssh": {"port": 22}}]
+            )
+        with pytest.raises(ValueError, match="bad dest port"):
+            SSHTunnelManager.from_config([{
+                "hostname": "t",
+                "tunnel": {"bind": {"ip": "0.0.0.0", "port": 1},
+                           "dest": {"ip": "x", "port": "5432"}},
+            }])
+
+    def test_disabled_vpn_tolerates_bad_subnet(self):
+        vpn = VPNManager(enabled=False, subnet="garbage")
+        assert vpn.exposed_ports({"ports": "80"}) == [80]
+
+    def test_wireguard_interface_address_subnet_ok(self):
+        VPNManager(enabled=True, subnet="10.76.0.1/16")  # host bits set
+
+    def test_ipv4_mapped_ipv6_matches_v4_cidr(self):
+        wl = OutboundWhitelist(enabled=True, ips=["10.0.0.0/8"])
+        assert wl.allows("http://[::ffff:10.0.0.1]/x")
+        assert not wl.allows("http://[::ffff:11.0.0.1]/x")

@@ -12,6 +12,7 @@ is the mesh. Each manager states its stance via `supported`/`reason`.
 from __future__ import annotations
 
 import fnmatch
+import ipaddress
 from dataclasses import dataclass, field
 from typing import Any
 from urllib.parse import urlparse
@@ -38,15 +39,41 @@ class VPNManager:
         "port registration is kept for peer discovery parity"
     )
 
+    def __post_init__(self) -> None:
+        # the subnet is config other tools consume (the reference hands it
+        # to wireguard) — a typo must fail at daemon start, not at first
+        # use. strict=False: WireGuard-style interface addresses
+        # (10.76.0.1/16) have host bits set and are fine. A DISABLED vpn's
+        # subnet is never consumed, so stale garbage there only warns —
+        # it must not brick a daemon whose feature is off.
+        try:
+            ipaddress.ip_network(self.subnet, strict=False)
+        except ValueError as e:
+            if self.enabled:
+                raise ValueError(f"vpn subnet {self.subnet!r}: {e}") from None
+            log.warning("ignoring invalid subnet on disabled vpn: %s", e)
+
     def setup(self) -> bool:
         if self.enabled:
             log.warning("vpn requested: %s", self.reason)
         return False
 
     def exposed_ports(self, algorithm_env: dict[str, Any]) -> list[int]:
-        """Ports an algorithm declares (reference: image EXPOSE labels)."""
+        """Ports an algorithm declares (reference: image EXPOSE labels).
+        Out-of-range numbers are dropped with a warning — the server's
+        Port entity validates 1..65535 and one bad entry must not sink
+        the whole registration."""
         raw = str(algorithm_env.get("ports", "") or "")
-        return [int(p) for p in raw.split(",") if p.strip().isdigit()]
+        ports = []
+        for p in raw.split(","):
+            if not p.strip().isdigit():
+                continue
+            n = int(p)
+            if 1 <= n <= 65535:
+                ports.append(n)
+            else:
+                log.warning("ignoring out-of-range exposed port %s", n)
+        return ports
 
 
 @dataclass
@@ -65,15 +92,43 @@ class OutboundWhitelist:
     ips: list[str] = field(default_factory=list)
     ports: list[int] = field(default_factory=list)
 
+    def _ip_allowed(self, addr: "ipaddress.IPv4Address | ipaddress.IPv6Address") -> bool:
+        """`ips` entries are exact addresses OR CIDR networks — the same
+        semantics as squid's `dst` acls (the reference whitelists
+        ip/subnet entries distinctly from dstdomain globs)."""
+        # [::ffff:10.0.0.1] IS 10.0.0.1: an IPv4 CIDR entry must treat
+        # both spellings identically (version-mismatched containment is
+        # silently False otherwise)
+        mapped = getattr(addr, "ipv4_mapped", None)
+        if mapped is not None:
+            addr = mapped
+        for entry in self.ips:
+            try:
+                if addr in ipaddress.ip_network(entry, strict=False):
+                    return True
+            except ValueError:
+                # not CIDR/address syntax: fall back to glob on the string
+                if fnmatch.fnmatch(str(addr), entry):
+                    return True
+        return False
+
     def allows(self, url: str) -> bool:
         if not self.enabled:
             return True
         parsed = urlparse(url if "//" in url else f"//{url}")
         host = parsed.hostname or ""
         port = parsed.port
-        host_ok = any(
-            fnmatch.fnmatch(host, pat) for pat in (self.domains + self.ips)
-        )
+        try:
+            addr = ipaddress.ip_address(host)
+        except ValueError:
+            addr = None
+        if addr is not None:
+            # a literal-IP URL must match an ip/CIDR entry; domain globs
+            # deliberately do NOT apply (squid: dstdomain never matches
+            # raw IPs — matching would let 10.* style globs leak)
+            host_ok = self._ip_allowed(addr)
+        else:
+            host_ok = any(fnmatch.fnmatch(host, pat) for pat in self.domains)
         port_ok = port is None or not self.ports or port in self.ports
         return host_ok and port_ok
 
@@ -98,10 +153,42 @@ class SSHTunnelManager:
             name = t.get("hostname") or t.get("name")
             if not name:
                 raise ValueError("ssh tunnel config needs a hostname/name")
+            cls._validate_shape(name, t)
             mgr.tunnels[name] = dict(t)
         if mgr.tunnels:
             log.warning("ssh tunnels configured: %s", mgr.reason)
         return mgr
+
+    @staticmethod
+    def _validate_shape(name: str, t: dict[str, Any]) -> None:
+        """Reject malformed reference-shaped config at daemon start.
+
+        The reference's tunnel entry nests ``ssh: {host, port, identity:
+        {username, key}}`` and ``tunnel: {bind: {ip, port}, dest: {ip,
+        port}}``; both blocks are optional here (the transport is N/A
+        on-pod) but when present they must be well-formed — a silently
+        mis-typed port would otherwise surface only as a confusing
+        data-loading failure deep inside an algorithm run."""
+        ssh = t.get("ssh")
+        if ssh is not None:
+            if not isinstance(ssh, dict) or not ssh.get("host"):
+                raise ValueError(f"ssh tunnel {name!r}: ssh block needs host")
+            port = ssh.get("port", 22)
+            if not isinstance(port, int) or not 1 <= port <= 65535:
+                raise ValueError(f"ssh tunnel {name!r}: bad ssh port {port!r}")
+        tunnel = t.get("tunnel")
+        if tunnel is not None:
+            for leg in ("bind", "dest"):
+                block = (tunnel or {}).get(leg)
+                if not isinstance(block, dict):
+                    raise ValueError(
+                        f"ssh tunnel {name!r}: tunnel needs a {leg} block"
+                    )
+                p = block.get("port")
+                if not isinstance(p, int) or not 1 <= p <= 65535:
+                    raise ValueError(
+                        f"ssh tunnel {name!r}: bad {leg} port {p!r}"
+                    )
 
     def endpoint(self, name: str) -> dict[str, Any]:
         if name not in self.tunnels:
