@@ -314,3 +314,7 @@ class TestConfigValidation:
         wl = OutboundWhitelist(enabled=True, ips=["10.0.0.0/8"])
         assert wl.allows("http://[::ffff:10.0.0.1]/x")
         assert not wl.allows("http://[::ffff:11.0.0.1]/x")
+
+    def test_malformed_port_fails_closed(self):
+        wl = OutboundWhitelist(enabled=True, domains=["*"])
+        assert not wl.allows("http://any.host:99999/x")

@@ -38,7 +38,9 @@ class TestPage:
         route — markup can't drift ahead of the API."""
         page = srv.test_client().get("/").body.decode()
         c = _login(srv)
-        calls = set(re.findall(r'api\("(GET|POST|DELETE)",\s*[`"]([\w/?=&]+)', page))
+        calls = set(re.findall(
+            r'api\("(GET|POST|PATCH|DELETE)",\s*[`"]([\w/?=&]+)', page
+        ))
         assert len(calls) >= 8
         for method, path in calls:
             path = path.split("?")[0]

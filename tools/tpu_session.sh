@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One TPU evidence session, ordered by wedge-risk (run when a probe shows
+# the tunnel healthy):
+#   1. device_engine_tpu  — plain XLA through the full control-plane stack
+#                           (safe); writes DEVICE_ENGINE_TPU.json
+#   2. bench.py           — full budgeted bench on the healthy chip
+#                           (safe); tee'd to BENCH_LOCAL.json for the
+#                           record (the driver's own BENCH_r{N}.json stays
+#                           the artifact of record); also warms the
+#                           compile cache for the driver's end-of-round run
+#   3. flash_attempt      — LAST: a compiled pallas_call can wedge the
+#                           tunnel machine-wide; by now the safe evidence
+#                           is already on disk. Writes FLASH_ATTEMPT.json;
+#                           on success bench's flash path graduates.
+# Each step is independently guarded; a wedge mid-sequence loses only the
+# later steps.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 device-engine on chip =="
+python tools/device_engine_tpu.py || true
+
+echo "== 2/3 full bench =="
+BENCH_BUDGET_S="${BENCH_BUDGET_S:-3000}" python bench.py | tee /tmp/bench_local.out || true
+tail -1 /tmp/bench_local.out > BENCH_LOCAL.json || true
+
+echo "== 3/3 flash attempt (wedge risk — last) =="
+python tools/flash_attempt.py || true
+
+echo "== session artifacts =="
+for f in DEVICE_ENGINE_TPU.json BENCH_LOCAL.json FLASH_ATTEMPT.json; do
+  echo "--- $f"; cat "$f" 2>/dev/null | head -c 600; echo
+done

@@ -117,7 +117,13 @@ class OutboundWhitelist:
             return True
         parsed = urlparse(url if "//" in url else f"//{url}")
         host = parsed.hostname or ""
-        port = parsed.port
+        try:
+            port = parsed.port
+        except ValueError:
+            # malformed/out-of-range port (":99999", ":abc"): the GATE must
+            # answer, and fail-closed beats a ValueError escaping into the
+            # algorithm run as a confusing non-policy crash
+            return False
         try:
             addr = ipaddress.ip_address(host)
         except ValueError:
