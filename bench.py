@@ -407,12 +407,18 @@ def worker_transformer() -> None:
         "BENCH_FLASH", flash_default
     ) == "1"
 
+    # BENCH_TF_REMAT=1: per-layer rematerialization — activation memory
+    # O(1) in depth, ~+1/3 FLOPs; the knob that lets larger batch/seq fit
+    # (B32 OOMed without it at the default shape)
+    remat = os.environ.get("BENCH_TF_REMAT", "0") == "1"
+
     def build(attention: str):
         cfg = FT.TransformerConfig(
             vocab=vocab, d_model=d, n_heads=heads, n_layers=layers,
             max_len=seq,
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
             attention=attention,
+            remat=remat,
         )
         eng = FT.make_engine(n_stations=1, seq_devices=1, cfg=cfg, lr=1e-3)
         tokens = eng.shard_tokens(
@@ -473,7 +479,8 @@ def worker_transformer() -> None:
         "final_loss": float(loss),
         "config": {"d_model": d, "n_layers": layers, "n_heads": heads,
                    "seq": seq, "batch": batch, "vocab": vocab,
-                   "dtype": "bfloat16" if on_tpu else "float32"},
+                   "dtype": "bfloat16" if on_tpu else "float32",
+                   "remat": remat},
     }
     print(json.dumps(out))
 

@@ -191,3 +191,35 @@ class TestStationPacking:
     def test_too_few_devices_for_seq_shards_rejected(self):
         with pytest.raises(ValueError, match="sequence shards"):
             FT.make_engine(n_stations=1, seq_devices=64, cfg=self._cfg())
+
+
+class TestRemat:
+    def test_remat_gradients_exact(self, devices):
+        """jax.checkpoint recomputes, never approximates: per-layer remat
+        must match the plain path to f32 rounding (XLA may fuse
+        differently across the checkpoint boundary — ~1 ULP, never
+        more)."""
+        import numpy as np
+
+        from vantage6_tpu.workloads import fed_transformer as FT
+
+        tokens = FT.make_federated_tokens(2, batch=2, seq_len=16, vocab=32)
+        outs = {}
+        for remat in (False, True):
+            cfg = FT.TransformerConfig(
+                vocab=32, d_model=16, n_heads=2, n_layers=2, max_len=32,
+                remat=remat,
+            )
+            eng = FT.make_engine(n_stations=2, seq_devices=1, cfg=cfg)
+            params, opt = eng.init(jax.random.key(0))
+            p1, _, loss = eng.round(
+                params, opt, eng.shard_tokens(tokens), jnp.ones(2)
+            )
+            outs[remat] = (float(loss), p1)
+        assert abs(outs[False][0] - outs[True][0]) < 1e-5
+        for a, b in zip(
+            jax.tree.leaves(outs[False][1]), jax.tree.leaves(outs[True][1])
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )

@@ -318,3 +318,7 @@ class TestConfigValidation:
     def test_malformed_port_fails_closed(self):
         wl = OutboundWhitelist(enabled=True, domains=["*"])
         assert not wl.allows("http://any.host:99999/x")
+
+    def test_malformed_ipv6_url_fails_closed(self):
+        wl = OutboundWhitelist(enabled=True, domains=["*"])
+        assert not wl.allows("http://[::1/x")

@@ -22,7 +22,19 @@ python tools/device_engine_tpu.py || true
 
 echo "== 2/3 full bench =="
 BENCH_BUDGET_S="${BENCH_BUDGET_S:-3000}" python bench.py | tee /tmp/bench_local.out || true
-tail -1 /tmp/bench_local.out > BENCH_LOCAL.json || true
+# last VALID json line (a kill mid-print leaves a truncated tail; earlier
+# complete lines still carry every finished leg — bench.py's contract)
+python - <<'PY' || true
+import json
+best = None
+for line in open("/tmp/bench_local.out"):
+    try:
+        best = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+if best is not None:
+    open("BENCH_LOCAL.json", "w").write(json.dumps(best) + "\n")
+PY
 
 echo "== 3/3 flash attempt (wedge risk — last) =="
 python tools/flash_attempt.py || true

@@ -115,14 +115,14 @@ class OutboundWhitelist:
     def allows(self, url: str) -> bool:
         if not self.enabled:
             return True
-        parsed = urlparse(url if "//" in url else f"//{url}")
-        host = parsed.hostname or ""
         try:
+            parsed = urlparse(url if "//" in url else f"//{url}")
+            host = parsed.hostname or ""
             port = parsed.port
         except ValueError:
-            # malformed/out-of-range port (":99999", ":abc"): the GATE must
-            # answer, and fail-closed beats a ValueError escaping into the
-            # algorithm run as a confusing non-policy crash
+            # malformed URL (unclosed IPv6 bracket, ":99999", ":abc"): the
+            # GATE must answer, and fail-closed beats a ValueError escaping
+            # into the algorithm run as a confusing non-policy crash
             return False
         try:
             addr = ipaddress.ip_address(host)
