@@ -52,6 +52,16 @@ class Database:
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA foreign_keys = ON")
         conn.execute("PRAGMA journal_mode = WAL")
+        # thread-per-request server (server/web.py): concurrent writers
+        # queue on the sqlite write lock. sqlite3.connect's default
+        # timeout already installs a 5 s busy handler; the pragma makes
+        # that contract EXPLICIT so nobody "optimizes" connect(timeout=0)
+        # without tripping over this line
+        conn.execute("PRAGMA busy_timeout = 5000")
+        # durable-enough with WAL (fsync at checkpoint, not per-commit);
+        # the per-commit fsync of FULL is the single-writer bottleneck
+        # under federation-scale polling
+        conn.execute("PRAGMA synchronous = NORMAL")
         return conn
 
     @property
