@@ -81,3 +81,101 @@ class TestQuantile:
     def test_bad_q_rejected(self):
         with pytest.raises(Exception, match="q must be"):
             _run(_frames(), column="age", q=1.5)
+
+
+class TestQuantileDevice:
+    """Device twin: the whole bisection as one jitted program must match
+    the pooled rank value AND the host-mode result, padding inert."""
+
+    def test_matches_pooled_and_host(self, devices):
+        import jax.numpy as jnp
+
+        from vantage6_tpu.core.mesh import FederationMesh
+        from vantage6_tpu.utils.datasets import pad_shards
+
+        frames = _frames(seed=9, sizes=(40, 0, 97, 13))
+        vals = [f["age"].to_numpy() for f in frames]
+        shards = [(v, np.zeros_like(v)) for v in vals]
+        sx, _, counts = pad_shards(shards, pad_to=100)
+        mask = (np.arange(100)[None, :] < counts[:, None]).astype(np.float64)
+        mesh = FederationMesh(len(frames))
+        pooled = np.sort(np.concatenate(vals))
+        for q in (0.1, 0.5, 0.9):
+            out = quantiles.quantile_device(
+                mesh, jnp.asarray(sx), jnp.asarray(mask), q=q
+            )
+            exact = pooled[int(np.ceil(q * len(pooled))) - 1]
+            assert out["n"] == len(pooled)
+            assert abs(out["value"] - exact) <= 1e-4 * max(1, abs(exact)), (
+                q, out["value"], exact
+            )
+            # and the host-mode bisection agrees with its device twin
+            host = _run(frames, column="age", q=q)
+            assert abs(out["value"] - host["value"]) <= 2e-4 * max(
+                1, abs(exact)
+            )
+
+    def test_caller_bounds_respected(self, devices):
+        import jax.numpy as jnp
+
+        from vantage6_tpu.core.mesh import FederationMesh
+        from vantage6_tpu.utils.datasets import pad_shards
+
+        frames = _frames(seed=2, sizes=(50, 30))
+        vals = [f["age"].to_numpy() for f in frames]
+        sx, _, counts = pad_shards([(v, np.zeros_like(v)) for v in vals])
+        n_max = sx.shape[1]
+        mask = (np.arange(n_max)[None, :] < counts[:, None]).astype(float)
+        mesh = FederationMesh(2)
+        out = quantiles.quantile_device(
+            mesh, jnp.asarray(sx), jnp.asarray(mask), q=0.5,
+            lo=-500.0, hi=500.0,
+        )
+        pooled = np.sort(np.concatenate(vals))
+        exact = pooled[int(np.ceil(0.5 * len(pooled))) - 1]
+        assert abs(out["value"] - exact) <= 1e-4 * max(1, abs(exact))
+
+    def test_empty_federation_and_bad_bounds_raise(self, devices):
+        import jax.numpy as jnp
+
+        from vantage6_tpu.core.mesh import FederationMesh
+
+        mesh = FederationMesh(2)
+        sx = np.zeros((2, 8))
+        zero_mask = np.zeros((2, 8))
+        with pytest.raises(ValueError, match="no rows"):
+            quantiles.quantile_device(
+                mesh, jnp.asarray(sx), jnp.asarray(zero_mask), q=0.5
+            )
+        # data in [20, 80]; caller bounds below it must raise, not return hi
+        rng = np.random.default_rng(0)
+        sx = rng.uniform(20, 80, (2, 8))
+        mask = np.ones((2, 8))
+        with pytest.raises(ValueError, match="widen the range"):
+            quantiles.quantile_device(
+                mesh, jnp.asarray(sx), jnp.asarray(mask), q=0.5,
+                lo=0.0, hi=10.0,
+            )
+        with pytest.raises(ValueError, match="lower lo"):
+            quantiles.quantile_device(
+                mesh, jnp.asarray(sx), jnp.asarray(mask), q=0.5,
+                lo=90.0, hi=100.0,
+            )
+        with pytest.raises(ValueError, match="invalid range"):
+            quantiles.quantile_device(
+                mesh, jnp.asarray(sx), jnp.asarray(mask), q=0.5,
+                lo=50.0, hi=40.0,
+            )
+
+    def test_integer_column_supported(self, devices):
+        import jax.numpy as jnp
+
+        from vantage6_tpu.core.mesh import FederationMesh
+
+        mesh = FederationMesh(2)
+        sx = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int64)
+        mask = np.ones((2, 4))
+        out = quantiles.quantile_device(
+            mesh, jnp.asarray(sx), jnp.asarray(mask), q=0.5
+        )
+        assert abs(out["value"] - 4.0) < 1e-4  # rank ceil(.5*8)=4 -> value 4

@@ -157,3 +157,129 @@ def central_quantile(
         "bounds_rounds": bounds_rounds,
         "bracket": [float(lo), float(hi)],
     }
+
+
+# --------------------------------------------------------------- device mode
+import functools
+
+
+@functools.cache
+def _quantile_runner(mesh: Any, n_iter: int):
+    """Compiled bisection program, cached per (mesh, n_iter) like glm's
+    _glm_runner: q and the bound sentinels enter as TRACED arguments, so
+    one compilation serves every quantile of same-shaped data."""
+    import jax
+    import jax.numpy as jnp
+
+    from vantage6_tpu.fed.collectives import fed_sum
+
+    def run(sx, m, q, lo, hi):
+        big = jnp.asarray(jnp.finfo(sx.dtype).max, sx.dtype)
+        n = fed_sum(mesh.fed_map(lambda mv: jnp.sum(mv), m))
+        # per-station masked extrema come back stacked [S]; the global
+        # bound is their min/max (NOT fed_sum — sums of mins are garbage)
+        lo = jnp.where(
+            jnp.isnan(lo),
+            jnp.min(
+                mesh.fed_map(
+                    lambda xv, mv: jnp.min(jnp.where(mv > 0, xv, big)), sx, m
+                )
+            ),
+            lo,
+        )
+        hi = jnp.where(
+            jnp.isnan(hi),
+            jnp.max(
+                mesh.fed_map(
+                    lambda xv, mv: jnp.max(jnp.where(mv > 0, xv, -big)), sx, m
+                )
+            ),
+            hi,
+        )
+        target = jnp.ceil(q * n)
+
+        def count_below(cut):
+            return fed_sum(
+                mesh.fed_map(
+                    lambda xv, mv: jnp.sum((xv <= cut) * mv), sx, m
+                )
+            )
+
+        def step(_, bracket):
+            blo, bhi = bracket
+            mid = 0.5 * (blo + bhi)
+            ge = count_below(mid) >= target
+            return jnp.where(ge, blo, mid), jnp.where(ge, mid, bhi)
+
+        blo, bhi = jax.lax.fori_loop(0, n_iter, step, (lo, hi))
+        # bracket evidence for the host-side guards (cannot raise in jit)
+        return bhi, n, count_below(lo), count_below(hi)
+
+    return jax.jit(run)
+
+
+def quantile_device(
+    mesh: Any,
+    sx: Any,        # [S, n_max] padded station values
+    row_mask: Any,  # [S, n_max] 1.0 for real rows
+    q: float = 0.5,
+    lo: float | None = None,
+    hi: float | None = None,
+    n_iter: int = 64,
+) -> dict[str, Any]:
+    """The WHOLE bisection as ONE jitted program (device twin of
+    `central_quantile`).
+
+    Where host mode pays a task round per count-below query, here every
+    bisection step is a per-station masked count under ``fed_map`` plus
+    one scalar all-reduce, and the ``lax.fori_loop`` over ``n_iter``
+    halvings keeps the loop compiler-friendly (fixed trip count — 64
+    steps shrink the bracket by 2^-64, f32/f64-exact for any practical
+    range). Bounds defaulting to the masked global min/max adds the same
+    stated disclosure as host mode's bounds round (two extreme values
+    per federation, computed on-device here). The host-mode error
+    contract is preserved: empty federations and caller bounds that do
+    not bracket the quantile raise instead of returning a plausible
+    wrong value.
+    """
+    import jax.numpy as jnp
+
+    if not 0.0 < q < 1.0:
+        raise ValueError("q must be in (0, 1)")
+    if lo is not None and hi is not None and not hi >= lo:
+        raise ValueError(f"invalid range [{lo}, {hi}]")
+    sx = jnp.asarray(sx)
+    if not jnp.issubdtype(sx.dtype, jnp.floating):
+        # integer columns (pad_shards preserves dtype): bisection needs a
+        # float value axis, and the NaN bound sentinel needs a float slot
+        sx = sx.astype(jnp.float32)
+    m = jnp.asarray(row_mask, sx.dtype)
+
+    value, n, below_lo, below_hi = _quantile_runner(mesh, n_iter)(
+        sx, m,
+        jnp.asarray(q, sx.dtype),
+        jnp.asarray(float("nan") if lo is None else lo, sx.dtype),
+        jnp.asarray(float("nan") if hi is None else hi, sx.dtype),
+    )
+    n = int(n)
+    if n == 0:
+        raise ValueError("no rows across the federation")
+    target = int(np.ceil(q * n))
+    # same bracket guards as host mode, applied only to CALLER bounds
+    # (auto bounds are the true extrema and bracket by construction)
+    if hi is not None and int(below_hi) < target:
+        raise ValueError(
+            f"hi={hi} has global rank {int(below_hi)} < target {target}; "
+            "widen the range"
+        )
+    if lo is not None and int(below_lo) >= target:
+        raise ValueError(
+            f"lo={lo} already has global rank {int(below_lo)} >= target "
+            f"{target}: the quantile lies at or below lo; lower lo"
+        )
+    return {
+        "quantile": q,
+        "value": float(value),
+        "n": n,
+        "bisection_steps": n_iter,
+    }
