@@ -104,3 +104,68 @@ class TestCorrelation:
         np.testing.assert_allclose(
             np.asarray(corr, np.float64), host["matrix"], atol=2e-4
         )
+
+
+class TestCrosstabDevice:
+    def _frames(self, sizes=(60, 0, 33), seed=4):
+        rng = np.random.default_rng(seed)
+        return [
+            pd.DataFrame({
+                "sex": rng.choice(["f", "m"], n),
+                "stage": rng.choice(["I", "II", "III"], n),
+            })
+            for n in sizes
+        ]
+
+    def test_matches_pooled_pandas(self, devices):
+        import jax.numpy as jnp
+
+        from vantage6_tpu.core.mesh import FederationMesh
+
+        frames = self._frames()
+        rc, cc, m, rows, cols = stats.encode_crosstab(frames, "sex", "stage")
+        mesh = FederationMesh(len(frames))
+        out = stats.crosstab_device(
+            mesh, jnp.asarray(rc), jnp.asarray(cc), jnp.asarray(m),
+            n_row_cats=len(rows), n_col_cats=len(cols),
+        )
+        pooled = pd.concat(frames, ignore_index=True)
+        expect = pd.crosstab(pooled["sex"], pooled["stage"])
+        for i, r in enumerate(rows):
+            for j, c in enumerate(cols):
+                want = int(expect.loc[r, c]) if (
+                    r in expect.index and c in expect.columns
+                ) else 0
+                assert out["table"][i][j] == want, (r, c)
+
+    def test_suppression_poisons_like_host(self, devices):
+        import jax.numpy as jnp
+
+        from vantage6_tpu.core.mesh import FederationMesh
+        from vantage6_tpu.runtime.federation import federation_from_datasets
+
+        frames = self._frames(sizes=(40, 7), seed=9)
+        # host mode with suppression
+        fed = federation_from_datasets(frames, {"st": stats})
+        t = fed.create_task(
+            "st",
+            {"method": "central_crosstab",
+             "kwargs": {"row_col": "sex", "col_col": "stage",
+                        "min_cell_count": 3}},
+            organizations=[0],
+        )
+        host = fed.wait_for_results(t.id)[0]
+        # device mode, same threshold
+        rc, cc, m, rows, cols = stats.encode_crosstab(frames, "sex", "stage")
+        mesh = FederationMesh(len(frames))
+        dev = stats.crosstab_device(
+            mesh, jnp.asarray(rc), jnp.asarray(cc), jnp.asarray(m),
+            n_row_cats=len(rows), n_col_cats=len(cols), min_cell_count=3,
+        )
+        # identical poisoning pattern and identical visible counts
+        assert host["rows"] == rows and host["columns"] == cols
+        for i in range(len(rows)):
+            for j in range(len(cols)):
+                assert dev["table"][i][j] == host["table"][i][j], (
+                    rows[i], cols[j], dev["table"][i][j], host["table"][i][j]
+                )

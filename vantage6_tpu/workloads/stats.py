@@ -170,3 +170,90 @@ def correlation_device(
     cov = o / n - jnp.outer(mean, mean)
     sd = jnp.sqrt(jnp.clip(jnp.diag(cov), 1e-30))
     return cov / jnp.outer(sd, sd)
+
+
+# --------------------------------------------------------- crosstab (device)
+def encode_crosstab(
+    frames: list[Any], row_col: str, col_col: str
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[str], list[str]]:
+    """Per-station frames -> padded integer codes for `crosstab_device`.
+
+    HOST-SIDE PREP HELPER (tests, single-trust-domain analysis): it sees
+    every station's rows, like any array-resident entry path. In a real
+    federation each station builds its own code/mask shard locally (the
+    device-engine pattern — workloads/device_engine.py) against the shared
+    vocabularies, which are the only thing that must be agreed globally
+    (sorted union — the same global-grid construction as the KM event-time
+    grid). Padding delegates to utils.datasets.pad_shards — the single
+    home of the SPMD static-shape padding invariant.
+    """
+    from vantage6_tpu.utils.datasets import pad_shards
+
+    series = [
+        (f[row_col].astype(str), f[col_col].astype(str)) for f in frames
+    ]
+    rows = sorted({v for rs, _ in series for v in rs})
+    cols = sorted({v for _, cs in series for v in cs})
+    ridx = {v: i for i, v in enumerate(rows)}
+    cidx = {v: i for i, v in enumerate(cols)}
+    shards = [
+        (
+            np.asarray([ridx[v] for v in rs], np.int32),
+            np.asarray([cidx[v] for v in cs], np.int32),
+        )
+        for rs, cs in series
+    ]
+    pad_to = max(1, max((len(rs) for rs, _ in shards), default=1))
+    rc, cc, counts = pad_shards(shards, pad_to=pad_to)
+    m = (np.arange(pad_to)[None, :] < counts[:, None]).astype(np.float32)
+    return rc, cc, m, rows, cols
+
+
+def crosstab_device(
+    mesh: FederationMesh,
+    row_codes: jax.Array,  # [S, n_max] int codes (pad 0, masked out)
+    col_codes: jax.Array,  # [S, n_max]
+    row_mask: jax.Array,   # [S, n_max] 1.0 for real rows
+    n_row_cats: int,
+    n_col_cats: int,
+    min_cell_count: int = 0,
+) -> dict[str, Any]:
+    """Pooled contingency table as ONE SPMD program (device twin of
+    `central_crosstab`).
+
+    Each station's [R, C] block is an int32 scatter-add under ``fed_map``
+    (exact for any practical count — no float accumulation); the pooled
+    table is one all-reduce. Disclosure control keeps host-mode semantics:
+    a station cell in (0, min_cell_count) poisons the pooled cell (None).
+    When stations contribute their own shards (see `encode_crosstab`),
+    the per-station blocks exist only inside the compiled program and
+    nothing below the pooled aggregate reaches the aggregating host.
+    """
+    m = jnp.asarray(row_mask)
+
+    def run(rc, cc, m):
+        def station_table(rcv, ccv, mv):
+            flat = rcv.astype(jnp.int32) * n_col_cats + ccv.astype(jnp.int32)
+            t = jnp.zeros((n_row_cats * n_col_cats,), jnp.int32)
+            return t.at[flat].add(mv.astype(jnp.int32)).reshape(
+                n_row_cats, n_col_cats
+            )
+
+        tables = mesh.fed_map(station_table, rc, cc, m)       # [S, R, C]
+        pooled = fed_sum(tables)
+        # suppressed anywhere -> unknown total (host-mode poisoning rule)
+        viol = (tables > 0) & (tables < min_cell_count)
+        poisoned = fed_sum(viol.astype(jnp.int32)) > 0
+        return pooled, poisoned
+
+    pooled, poisoned = jax.jit(run)(
+        jnp.asarray(row_codes), jnp.asarray(col_codes), m
+    )
+    pooled = np.asarray(pooled)
+    poisoned = np.asarray(poisoned)
+    table = [
+        [None if poisoned[r, c] else int(pooled[r, c])
+         for c in range(n_col_cats)]
+        for r in range(n_row_cats)
+    ]
+    return {"table": table, "suppressed_below": min_cell_count}
