@@ -96,6 +96,9 @@ MIN_LEG_S = 45.0        # don't even start a leg with less than this left
 # unavailable the headline metric must still produce a number, and an
 # honest small config beats a timeout.
 SPMD_CPU_TIMEOUT_S = 900
+# agg_modes leg (sharded server update): 3 modes x (compile + warm +
+# timed chain) of a tiny 8-station/2-round config — ~2-4 min on this host.
+AGG_TIMEOUT_S = 600
 SPMD_CPU_STATIONS = 4   # degraded-CPU federation size, shared by BOTH legs
 SPMD_CPU_ROUNDS = 2     # degraded-CPU rounds per execution, BOTH legs
 ACC_TOLERANCE = 0.05    # |acc_spmd - acc_baseline| for "accuracy_parity"
@@ -563,6 +566,147 @@ def worker_fedoverhead() -> None:
     }))
 
 
+def worker_agg() -> None:
+    """agg_modes leg: the server-update aggregation strategies compared on
+    the SAME federation — replicated (fed_mean all-reduce), scattered
+    (reduce-scatter + ZeRO-1 sharded optax + all-gather), scattered+bf16
+    (bf16 on-wire deltas). Reports, per mode: rounds/sec, estimated
+    collective bytes/round for the server update, measured per-device
+    aggregation-state bytes (moments, from the executed program's actual
+    shardings), device peak memory when the backend exposes it, and the
+    final-param divergence vs replicated (parity evidence).
+
+    Sized small (local_steps=1, batch 8, 32 rows/station): the leg measures
+    AGGREGATION strategies, not local training throughput — the config just
+    has to make the update path a visible fraction of the round.
+    """
+    jax = _worker_setup()
+    import jax.numpy as jnp
+    import optax
+
+    from vantage6_tpu.core.mesh import FederationMesh
+    from vantage6_tpu.fed.collectives import flat_size, padded_flat_size
+    from vantage6_tpu.runtime.metrics import device_peak_bytes
+    from vantage6_tpu.workloads import fedavg_mnist as W
+
+    n_st = int(os.environ.get("BENCH_AGG_STATIONS", "8"))
+    rounds = int(os.environ.get("BENCH_AGG_ROUNDS", "2"))
+    mesh = FederationMesh(n_st)
+    d = mesh.station_axis_size
+    sx, sy, counts = W.make_federated_data(
+        n_st, n_per_station=32, mesh=mesh, noise=SYNTH_NOISE
+    )
+    key = jax.random.key(0)
+    p0 = W.init_params(jax.random.fold_in(key, 1))
+    mask = jnp.ones_like(counts)
+    n_params = flat_size(p0)
+    n_pad = padded_flat_size(n_params, d)
+
+    def est_collective_bytes(mode: str) -> int:
+        """Per-device on-wire bytes/round of the SERVER UPDATE collectives
+        (ring algorithm: each of reduce-scatter / all-gather moves
+        (D-1)/D * N elements per device; an all-reduce is both halves)."""
+        half = (d - 1) / d * n_pad
+        if mode == "replicated":
+            return int(2 * half * 4)  # f32 all-reduce of the mean delta
+        wire = 2 if mode == "scattered_bf16" else 4
+        return int(half * wire + half * 4)  # rs(comm_dtype) + ag(f32 params)
+
+    def per_device_state_bytes(opt_state) -> int:
+        per: dict = {}
+        for leaf in jax.tree.leaves(opt_state):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            for sh in leaf.addressable_shards:
+                key_ = getattr(sh.device, "id", sh.device)
+                per[key_] = per.get(key_, 0) + sh.data.nbytes
+        return max(per.values()) if per else 0
+
+    modes = [
+        ("replicated", {}),
+        ("scattered", dict(shard_server_update=True)),
+        ("scattered_bf16",
+         dict(shard_server_update=True, comm_dtype=jnp.bfloat16)),
+    ]
+    per_mode: dict = {}
+    final_params: dict = {}
+    for name, kw in modes:
+        eng = W.make_engine(
+            mesh, local_steps=1, batch_size=8, local_lr=LR,
+            server_optimizer=optax.adam(1e-2), **kw,
+        )
+        opt0 = eng.init(p0)
+        args = (p0, opt0, sx, sy, counts, mask, key)
+        # memory_stats() peaks are PROCESS-LIFETIME monotonic: a per-mode
+        # absolute reading would inherit earlier modes' high-water mark, so
+        # report the delta (0 = this mode never exceeded the prior peak);
+        # the sharding comparison itself rests on agg_state_bytes_per_device,
+        # which is measured from each program's own output shardings.
+        peak_before = device_peak_bytes()
+        t0 = time.perf_counter()
+        compiled = eng._run.lower(*args, n_rounds=rounds).compile()
+        compile_s = time.perf_counter() - t0
+        p1, o1, _ = compiled(*args)  # warm; o1 carries the PROGRAM's shardings
+        jax.block_until_ready(o1)
+
+        def step(state, i):
+            p, o = state
+            p, o, losses = compiled(
+                p, o, sx, sy, counts, mask, jax.random.fold_in(key, 100 + i)
+            )
+            return (p, o), losses
+
+        _, times = _timed_chain(jax, step, (p1, o1))
+        dt = _median(times)
+        # the warm call already ran this deterministic program on `args`
+        final_params[name] = p1
+        peak_after = device_peak_bytes()
+        per_mode[name] = {
+            "rounds_per_sec": round(rounds / dt, 3),
+            "round_time_ms": round(1e3 * dt / rounds, 3),
+            "run_times_s": [round(t, 4) for t in times],
+            "compile_seconds": round(compile_s, 1),
+            "est_collective_bytes_per_round": est_collective_bytes(name),
+            "agg_state_bytes_per_device": per_device_state_bytes(o1),
+            "device_peak_bytes_delta": (
+                None if peak_before is None or peak_after is None
+                else peak_after - peak_before
+            ),
+        }
+
+    def max_param_diff(a, b) -> float:
+        return max(
+            float(jnp.max(jnp.abs(x - y)))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    rep = per_mode["replicated"]
+    scat = per_mode["scattered"]
+    print(json.dumps({
+        "n_stations": n_st,
+        "station_axis_size": d,
+        "rounds_per_exec": rounds,
+        "n_params": n_params,
+        "modes": per_mode,
+        "param_maxdiff_scattered_vs_replicated": max_param_diff(
+            final_params["replicated"], final_params["scattered"]
+        ),
+        "param_maxdiff_bf16_vs_replicated": max_param_diff(
+            final_params["replicated"], final_params["scattered_bf16"]
+        ),
+        # acceptance probes: scattered must not be slower than replicated
+        # (CPU mesh) and must cut per-device aggregation-state memory D>1
+        "scattered_not_slower": bool(
+            scat["rounds_per_sec"] >= rep["rounds_per_sec"] * 0.95
+        ),
+        "agg_state_memory_cut": round(
+            rep["agg_state_bytes_per_device"]
+            / max(scat["agg_state_bytes_per_device"], 1), 2
+        ),
+        "platform": jax.devices()[0].platform,
+    }))
+
+
 def worker_baseline() -> None:
     """Reference-shaped rounds: sequential stations + JSON payload hops.
 
@@ -883,6 +1027,24 @@ def main() -> None:
     legs_done.append(leg_marker("baseline", base, base_diag))
     emit()
 
+    # ---- server-update aggregation modes (sharded update PR) ----------
+    agg, agg_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        agg, agg_diag = _run_worker(
+            "agg", force_cpu=not tpu_ok,
+            timeout_s=leg_timeout(AGG_TIMEOUT_S),
+        )
+    if agg is None and tpu_ok and remaining() > MIN_LEG_S:
+        agg, agg_diag = _run_worker(
+            "agg", force_cpu=True, timeout_s=leg_timeout(AGG_TIMEOUT_S),
+        )
+    if agg is not None:
+        out["agg_modes"] = agg
+    else:
+        out["agg_modes_error"] = agg_diag
+    legs_done.append(leg_marker("agg", agg, agg_diag))
+    emit()
+
     # ---- MXU utilization metric (transformer) -------------------------
     tf, tf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
     if remaining() > MIN_LEG_S:
@@ -1015,6 +1177,7 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         {"probe": worker_probe,
          "spmd": worker_spmd,
+         "agg": worker_agg,
          "baseline": worker_baseline,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()

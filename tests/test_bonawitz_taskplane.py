@@ -14,6 +14,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytest.importorskip("cryptography")  # protocol rounds derive X25519 keys
+
 from vantage6_tpu.client import UserClient
 from vantage6_tpu.node.daemon import NodeDaemon
 from vantage6_tpu.server.app import ServerApp

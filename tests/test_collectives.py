@@ -68,6 +68,47 @@ def test_sharded_aggregation_under_jit():
     np.testing.assert_allclose(np.asarray(out), x.mean(0), rtol=1e-4, atol=1e-5)
 
 
+# ----------------------------------------------------- bf16 numerics contract
+def test_bf16_leaf_rounding_contract():
+    """Pins the documented numerics contract (_norm_weights docstring):
+
+    - integer ``weights`` are upcast to f32 (no truncation/overflow);
+    - ``fed_mean`` on bf16 leaves computes IN bf16 — the result is bf16 and
+      carries visible rounding error vs the f32 truth;
+    - the scattered path accumulates in f32, so (on the same inputs) it is
+      at least as accurate as the bf16-dtype path — the property that makes
+      ``comm_dtype=bfloat16`` a wire format and not a precision downgrade
+      of the whole aggregation.
+    """
+    fm = FederationMesh(8)
+    rng = np.random.default_rng(0)
+    x_f32 = rng.normal(0, 10, size=(8, 64)).astype(np.float32)
+    x_bf16 = jnp.asarray(x_f32, jnp.bfloat16)
+    w_int = jnp.asarray(rng.integers(1, 100, size=8), jnp.int32)
+
+    # integer weights: exact upcast (f32 holds ints < 2^24 exactly)
+    out_int = C.fed_mean(jnp.asarray(x_f32), weights=w_int)
+    w_f = np.asarray(w_int, np.float32)
+    truth_f32 = (x_f32 * w_f[:, None]).sum(0) / w_f.sum()
+    np.testing.assert_allclose(np.asarray(out_int), truth_f32,
+                               rtol=1e-5, atol=1e-5)
+
+    # bf16 leaves: bf16 in, bf16 out, bf16 rounding
+    truth = (np.asarray(x_bf16, np.float32) * w_f[:, None]).sum(0) / w_f.sum()
+    out_bf = C.fed_mean(x_bf16, weights=w_int)
+    assert out_bf.dtype == jnp.bfloat16
+    err_bf = np.abs(np.asarray(out_bf, np.float32) - truth).max()
+    # worst case ~ a few bf16 ulps of the magnitude scale; it must be
+    # VISIBLE (this is real rounding, not noise) yet bounded
+    assert 0 < err_bf < 0.25, err_bf
+
+    out_scat = C.fed_mean_scattered_tree(fm, x_bf16, weights=w_int)
+    assert out_scat.dtype == jnp.bfloat16  # cast back to the leaf dtype
+    err_scat = np.abs(np.asarray(out_scat, np.float32) - truth).max()
+    # f32 accumulation: error only from the final bf16 cast (1/2 ulp)
+    assert err_scat <= err_bf + 1e-6, (err_scat, err_bf)
+
+
 # ------------------------------------------------------------- secure sum
 def test_secure_sum_exact_cancellation():
     x = RNG.uniform(-5, 5, size=(8, 32)).astype(np.float32)

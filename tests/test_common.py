@@ -26,6 +26,9 @@ def rsa_dir(tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def rsa_pair(rsa_dir):
+    # real-crypto tests skip (not fail) where cryptography is not installed;
+    # the module still collects so DummyCryptor/context/artifact tests run
+    pytest.importorskip("cryptography")
     # 4096-bit keygen is slow; one pair for the whole module.
     a = RSACryptor(rsa_dir / "a.pem")
     b = RSACryptor(rsa_dir / "b.pem")
@@ -73,6 +76,23 @@ class TestEncryption:
         a, _ = rsa_pair
         with pytest.raises(ValueError, match="malformed"):
             a.decrypt_str_to_bytes("notthreeparts")
+
+    def test_missing_cryptography_raises_clearly(self, monkeypatch):
+        """With `cryptography` absent the module must still import (lazy
+        import satellite) and real-crypto entry points must raise a CLEAR
+        RuntimeError on first use, not an ImportError mid-operation."""
+        from vantage6_tpu.common import encryption as enc
+
+        monkeypatch.setattr(
+            enc, "_CRYPTOGRAPHY_ERROR", ModuleNotFoundError("cryptography")
+        )
+        with pytest.raises(RuntimeError, match="cryptography"):
+            RSACryptor.create_new_rsa_key()
+        with pytest.raises(RuntimeError, match="cryptography"):
+            RSACryptor(b"not-a-key")
+        # the unencrypted path must stay fully functional
+        c = DummyCryptor()
+        assert c.decrypt_str_to_bytes(c.encrypt_bytes_to_str(b"x", "")) == b"x"
 
 
 class TestArtifactRef:

@@ -63,8 +63,64 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# Not every jaxlib CPU build can run cross-process collectives ("Multiprocess
+# computations aren't implemented on the CPU backend"); probe once per module
+# with a minimal 2-process psum and SKIP (capability gate, not a product bug)
+# where the backend can't.
+_PROBE = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(sys.argv[1], 2, int(sys.argv[2]))
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(jax.devices(), ("x",))
+    x = jax.device_put(jnp.ones(2), NamedSharding(mesh, P("x")))
+    out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+    print("PROBE_OK", float(out), flush=True)
+    """
+)
+
+
+@pytest.fixture(scope="module")
+def mp_cpu_collectives(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mp_probe")
+    script = tmp / "probe.py"
+    script.write_text(_PROBE)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PALLAS_AXON_POOL_IPS": "",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=90)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("multiprocess CPU collective probe timed out")
+    if any(rc != 0 or "PROBE_OK" not in out for rc, out, _ in outs):
+        pytest.skip(
+            "this jaxlib CPU backend cannot run multiprocess collectives: "
+            + (outs[0][2] or "")[-300:]
+        )
+
+
 @pytest.fixture()
-def cluster(tmp_path):
+def cluster(tmp_path, mp_cpu_collectives):
     """Server in THIS process; two device-engine daemons as OS processes,
     each a jax.distributed member with one CPU device and its own CSV."""
     rng = np.random.default_rng(42)

@@ -42,8 +42,10 @@ def test_run_rounds_deterministic(mesh, small_engine, fed_data):
     sx, sy, counts = fed_data
     key = jax.random.key(7)
     p0 = W.init_params(jax.random.fold_in(key, 1))
-    r1 = small_engine.run_rounds(p0, sx, sy, counts, key, 3)[2]
-    r2 = small_engine.run_rounds(p0, sx, sy, counts, key, 3)[2]
+    # donate=False: p0/key are reused across calls, so the default donating
+    # fast path (which consumes its inputs) must be opted out of here
+    r1 = small_engine.run_rounds(p0, sx, sy, counts, key, 3, donate=False)[2]
+    r2 = small_engine.run_rounds(p0, sx, sy, counts, key, 3, donate=False)[2]
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
 
 

@@ -181,3 +181,36 @@ def test_federation_from_datasets_array_stacking():
     stacked = fed.stacked_data()
     assert stacked.shape == (4, 4, 2)
     assert float(np.asarray(stacked[2]).mean()) == 2.0
+
+
+def test_aggregate_stacked_modes_agree():
+    """Device-mode central aggregation: replicated vs scattered vs
+    scattered_bf16 on a device-step task's stacked result, with one
+    station offline (its run stays PENDING, the mask excludes it)."""
+    from vantage6_tpu.algorithm.decorators import device_step
+
+    @device_step
+    def local_sum(d):
+        import jax.numpy as jnp
+
+        return {"s": jnp.sum(d, axis=0)}
+
+    data_ = [np.full((4, 2), i, np.float32) for i in range(4)]
+    fed = federation_from_datasets(data_, algorithms={"dev": {"sum": local_sum}})
+    fed.set_station_online(1, False)
+    task = fed.create_task("dev", {"method": "sum"})
+    rep = fed.aggregate_stacked(task.id)
+    scat = fed.aggregate_stacked(task.id, agg_mode="scattered")
+    np.testing.assert_allclose(
+        np.asarray(rep["s"]), np.asarray(scat["s"]), atol=1e-5
+    )
+    bf = fed.aggregate_stacked(task.id, agg_mode="scattered_bf16")
+    np.testing.assert_allclose(
+        np.asarray(rep["s"]), np.asarray(bf["s"]), atol=0.25
+    )
+    # station 1 (offline) excluded: mean of 4*[0, 2, 3] over 3 stations
+    np.testing.assert_allclose(
+        np.asarray(rep["s"]), np.full(2, 4 * (0 + 2 + 3) / 3.0), atol=1e-5
+    )
+    with pytest.raises(ValueError, match="agg_mode"):
+        fed.aggregate_stacked(task.id, agg_mode="bogus")

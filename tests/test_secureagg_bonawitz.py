@@ -9,6 +9,8 @@ holds by falsely declaring its sender dropped.
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography")  # X25519/Shamir protocol under test
+
 from vantage6_tpu.common import secureagg_bonawitz as bon
 from vantage6_tpu.common import secureagg_dh as dh
 from vantage6_tpu.common import shamir

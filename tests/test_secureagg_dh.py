@@ -9,6 +9,8 @@ the pairwise secrets, which require a station private key to derive)."""
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography")  # X25519 is the module under test
+
 from vantage6_tpu import native
 from vantage6_tpu.common import secureagg_dh as dh
 

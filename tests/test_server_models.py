@@ -88,6 +88,10 @@ class TestORM:
         assert m.Organization.count(country="DE") == 5
 
     def test_schema_migration_adds_columns(self, db):
+        import sqlite3
+
+        if tuple(map(int, sqlite3.sqlite_version.split("."))) < (3, 35):
+            pytest.skip("ALTER TABLE ... DROP COLUMN needs sqlite >= 3.35")
         # simulate an old table missing a column, then re-ensure
         db.execute("ALTER TABLE organization DROP COLUMN domain")
         m.Organization.ensure_schema()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Fail fast when pytest collection has ANY errors.
+
+A missing optional dependency once turned 20 test modules into collection
+errors that `--continue-on-collection-errors` quietly rode past — zeroing
+out most of the suite while the run still "completed". This gate runs
+`pytest --collect-only -q` and exits non-zero with the import chain of
+every broken module, so a collection regression can never again hide
+inside a green-looking run.
+
+Usage:
+    python tools/check_collect.py [pytest target, default: tests/]
+
+Exit codes: 0 = clean collection; 1 = collection errors (details printed);
+2 = pytest itself could not run.
+"""
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+
+
+def main(argv: list[str]) -> int:
+    target = argv[1:] or ["tests/"]
+    cmd = [
+        sys.executable, "-m", "pytest", *target,
+        "--collect-only", "-q",
+        "-p", "no:cacheprovider",
+        "--continue-on-collection-errors",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    out = proc.stdout + proc.stderr
+
+    error_blocks: list[str] = []
+    block: list[str] = []
+    in_block = False
+    for line in out.splitlines():
+        if re.match(r"_+ ERROR collecting .* _+", line):
+            if block:
+                error_blocks.append("\n".join(block))
+            block, in_block = [line], True
+        elif in_block and (line.startswith("=") or line.startswith("_____")):
+            error_blocks.append("\n".join(block))
+            block, in_block = [], False
+        elif in_block:
+            block.append(line)
+    if block:
+        error_blocks.append("\n".join(block))
+
+    n_errors = len(error_blocks)
+    summary = re.search(r"(\d+) errors? during collection", out)
+    if summary:
+        n_errors = max(n_errors, int(summary.group(1)))
+
+    if n_errors == 0 and proc.returncode == 0:
+        tests = re.findall(r"^(\d+) tests? collected", out, re.M)
+        counted = tests[-1] if tests else "all"
+        print(f"collection clean: {counted} tests collected")
+        return 0
+    if n_errors == 0:
+        # pytest failed without reporting collection errors (bad target, ...)
+        sys.stderr.write(out[-2000:] + "\n")
+        sys.stderr.write(f"pytest exited rc={proc.returncode}\n")
+        return 2
+
+    sys.stderr.write(
+        f"COLLECTION BROKEN: {n_errors} error(s). Modules and import "
+        "chains:\n\n"
+    )
+    for blk in error_blocks:
+        sys.stderr.write(blk.rstrip() + "\n\n")
+    # one-line-per-module digest (the part worth reading in CI logs)
+    for mod, exc in re.findall(
+        r"ERROR collecting (\S+).*?\nE\s+(\w+Error[^\n]*)", out, re.S
+    ):
+        sys.stderr.write(f"  {mod}: {exc.strip()}\n")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

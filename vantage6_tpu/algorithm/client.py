@@ -73,6 +73,19 @@ class AlgorithmClient:
             )
         return t.stacked_result, t.participation
 
+    def aggregate_stacked(
+        self, task_id: int, weights: Any = None,
+        agg_mode: str = "replicated",
+    ) -> Any:
+        """Masked weighted-mean over a device-mode task's stacked result —
+        ``agg_mode`` selects replicated (all-reduce) vs scattered
+        (reduce-scatter + all-gather, optionally bf16 on the wire)
+        aggregation; see Federation.aggregate_stacked."""
+        self._fed.wait_for_results(task_id)  # raise on failures
+        return self._fed.aggregate_stacked(
+            task_id, weights=weights, agg_mode=agg_mode
+        )
+
 
 class _TaskSubClient:
     def __init__(self, parent: AlgorithmClient):

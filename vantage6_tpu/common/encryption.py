@@ -18,9 +18,35 @@ import base64
 import os
 from pathlib import Path
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# `cryptography` is OPTIONAL: environments that never encrypt (CI, the SPMD
+# simulator with DummyCryptor collaborations) must still be able to import
+# this module — and everything that transitively imports it (node daemon,
+# proxy, runtime) — without the package installed. Real crypto use fails
+# loudly via _require_cryptography() on FIRST USE, not at import time.
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    _CRYPTOGRAPHY_ERROR: Exception | None = None
+except ModuleNotFoundError as _e:  # pragma: no cover - exercised in CI env
+    hashes = serialization = padding = rsa = None  # type: ignore[assignment]
+    _CRYPTOGRAPHY_ERROR = _e
+
+
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise RuntimeError(
+            "the 'cryptography' package is required for RSA/AES payload "
+            "encryption but is not installed; install it or use "
+            "DummyCryptor (unencrypted collaborations)"
+        ) from _CRYPTOGRAPHY_ERROR
+
+
+def _aesgcm():
+    _require_cryptography()
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    return AESGCM
+
 
 SEPARATOR = "$"
 
@@ -64,7 +90,8 @@ class RSACryptor(CryptorBase):
 
     KEY_BITS = 4096
 
-    def __init__(self, private_key: rsa.RSAPrivateKey | bytes | str | Path):
+    def __init__(self, private_key: "rsa.RSAPrivateKey | bytes | str | Path"):
+        _require_cryptography()
         if isinstance(private_key, rsa.RSAPrivateKey):
             self.private_key = private_key
         elif isinstance(private_key, bytes):
@@ -90,7 +117,8 @@ class RSACryptor(CryptorBase):
             )
 
     @classmethod
-    def create_new_rsa_key(cls) -> rsa.RSAPrivateKey:
+    def create_new_rsa_key(cls) -> "rsa.RSAPrivateKey":
+        _require_cryptography()
         return rsa.generate_private_key(
             public_exponent=65537, key_size=cls.KEY_BITS
         )
@@ -127,6 +155,7 @@ class RSACryptor(CryptorBase):
 
     # -------------------------------------------------------------- transport
     def encrypt_bytes_to_str(self, data: bytes, pubkey_base64: str) -> str:
+        AESGCM = _aesgcm()
         recipient = serialization.load_pem_public_key(
             self.str_to_bytes(pubkey_base64)
         )
@@ -151,6 +180,7 @@ class RSACryptor(CryptorBase):
     ) -> bool:
         """Check an RSA-PSS(SHA-256) signature against an organization's
         registered public key (base64 PEM, as stored by the server)."""
+        _require_cryptography()
         from cryptography.exceptions import InvalidSignature
 
         pub = serialization.load_pem_public_key(
@@ -185,6 +215,6 @@ class RSACryptor(CryptorBase):
                 label=None,
             ),
         )
-        return AESGCM(session_key).decrypt(
+        return _aesgcm()(session_key).decrypt(
             self.str_to_bytes(nonce_s), self.str_to_bytes(ct_s), None
         )

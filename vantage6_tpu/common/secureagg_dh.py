@@ -55,14 +55,33 @@ from typing import Iterable, Mapping
 
 import numpy as np
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
+# `cryptography` is OPTIONAL (same stance as common.encryption): importing
+# this module — and workloads.secure_average etc. that reach it — must work
+# without the package; X25519 use fails loudly on first call instead.
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+    _CRYPTOGRAPHY_ERROR: Exception | None = None
+except ModuleNotFoundError as _e:  # pragma: no cover - exercised in CI env
+    X25519PrivateKey = X25519PublicKey = None  # type: ignore[assignment]
+    Encoding = PublicFormat = None  # type: ignore[assignment]
+    _CRYPTOGRAPHY_ERROR = _e
+
+
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise RuntimeError(
+            "the 'cryptography' package is required for X25519 DH mask "
+            "agreement but is not installed; install it or use the "
+            "single-seed masking path (fed.collectives.secure_sum)"
+        ) from _CRYPTOGRAPHY_ERROR
+
 
 from vantage6_tpu import native
 from vantage6_tpu.algorithm.context import current_environment
@@ -140,7 +159,8 @@ def derive_keypair(
     return keypair_from_ikm(keypair_ikm(station_secret, tag))
 
 
-def keypair_from_ikm(ikm: bytes) -> tuple[X25519PrivateKey, str]:
+def keypair_from_ikm(ikm: bytes) -> "tuple[X25519PrivateKey, str]":
+    _require_cryptography()
     priv = X25519PrivateKey.from_private_bytes(ikm)
     pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
     return priv, pub.hex()
@@ -222,6 +242,7 @@ def pairwise_seed(
     tag: bytes | str | int,
 ) -> bytes:
     """32-byte ChaCha20 key both ends of pair (i, j) derive identically."""
+    _require_cryptography()
     shared = priv.exchange(
         X25519PublicKey.from_public_bytes(bytes.fromhex(peer_pub_hex))
     )

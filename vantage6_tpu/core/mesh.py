@@ -59,6 +59,19 @@ STATION_AXIS = "station"
 DEVICE_AXIS = "device"
 
 
+def station_shard_map(mesh: "FederationMesh", fn: Callable[..., Any],
+                      in_specs: Any, out_specs: Any) -> Callable[..., Any]:
+    """``shard_map`` over a FederationMesh with variance checking disabled
+    (same rationale as ``fed_map``) — the entry point for explicit-collective
+    code (``fed.collectives`` scattered primitives) that needs
+    ``psum_scatter``/``all_gather`` with named-axis control instead of
+    leaving the reduction to GSPMD."""
+    return shard_map(
+        fn, mesh=mesh.mesh, in_specs=in_specs, out_specs=out_specs,
+        **_NO_VMA_KW,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class Station:
     """One data station (reference: a vantage6 node at an organization).

@@ -14,10 +14,17 @@ bit-accurate FedAvg-with-dropout without breaking the single-program model.
 """
 from __future__ import annotations
 
-from typing import Any
+import math
+from typing import Any, TYPE_CHECKING
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vantage6_tpu.core.mesh import STATION_AXIS, station_shard_map
+
+if TYPE_CHECKING:  # pragma: no cover
+    from vantage6_tpu.core.mesh import FederationMesh
 
 Pytree = Any
 
@@ -32,6 +39,24 @@ def _station_count(stacked: Pytree) -> int:
 def _norm_weights(
     n: int, weights: jax.Array | None, mask: jax.Array | None
 ) -> jax.Array:
+    """Normalize ``weights``/``mask`` into one float32 [n] weight vector.
+
+    NUMERICS CONTRACT: weights are always carried as float32 — integer (or
+    bf16) ``weights`` are upcast here. The *reduction* dtype is a separate
+    question and differs per primitive:
+
+    - ``fed_sum``/``fed_mean`` accumulate and divide **in each leaf's
+      dtype** (the f32 weights are cast down to the leaf dtype first). A
+      bf16 leaf therefore pays bf16 rounding once per station in the sum
+      and once in the division — with S stations the worst-case relative
+      error grows like S * 2^-8, which is visible for S >= ~16.
+    - ``fed_sum_scattered``/``fed_mean_scattered`` accumulate **in float32**
+      regardless of leaf dtype and return float32; ``comm_dtype`` only
+      narrows the cross-slot wire format (see their docstrings).
+
+    tests/test_collectives.py::test_bf16_leaf_rounding_contract pins the
+    first behavior so the scattered path's contract stays spelled out.
+    """
     w = jnp.ones((n,), jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
     if mask is not None:
         w = w * jnp.asarray(mask, jnp.float32)
@@ -70,6 +95,10 @@ def fed_mean(
     ``weights`` is typically per-station example counts ([S]); ``mask`` drops
     stations (failure injection / partial participation). Division is by the
     *effective* total weight so dropped stations don't bias the mean.
+
+    Accumulation and division happen in each leaf's own dtype (see
+    ``_norm_weights`` for the full numerics contract) — use
+    ``fed_mean_scattered`` when f32 accumulation over bf16 leaves matters.
     """
     n = _station_count(stacked)
     w = _norm_weights(n, weights, mask)
@@ -100,6 +129,182 @@ def fed_concat(stacked: Pytree) -> Pytree:
     true sizes, pair with per-station validity masks.
     """
     return jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), stacked)
+
+
+# --------------------------------------------------------------------------
+# Scattered aggregation: reduce-scatter primitives for the sharded server
+# update (ZeRO-1 style; Xu et al., arXiv:2004.13336).
+# --------------------------------------------------------------------------
+#
+# fed_mean above materializes the full aggregate REPLICATED on every mesh
+# slot — an all-reduce-shaped round whose per-slot memory and wire bytes
+# both scale with full model size. The scattered primitives instead:
+#
+#   1. each slot locally reduces its S/D stations' contributions (f32),
+#   2. flattens the partial-sum pytree into ONE padded f32 vector,
+#   3. `psum_scatter`s it over the station axis — each slot keeps only a
+#      1/D shard of the global sum (wire: (D-1)/D * N elements per slot,
+#      same as one all-reduce's reduce half; memory: N/D instead of N),
+#   4. the caller applies the server update shard-locally and re-replicates
+#      with `all_gather_stations` only once per round.
+#
+# ``comm_dtype`` (e.g. jnp.bfloat16) narrows step 3's on-wire dtype only:
+# the local accumulation (1) and everything after the scatter stay f32.
+
+
+def flat_size(tree: Pytree) -> int:
+    """Total element count of ``tree``'s leaves (static, host-side)."""
+    return sum(math.prod(x.shape) for x in jax.tree.leaves(tree))
+
+
+def padded_flat_size(n: int, d: int) -> int:
+    """``n`` rounded up to a multiple of ``d`` (psum_scatter divisibility)."""
+    return n + (-n) % d
+
+
+def flatten_tree(tree: Pytree, dtype: Any = jnp.float32) -> jax.Array:
+    """Ravel + concatenate every leaf into one flat [N] vector."""
+    parts = [x.astype(dtype).reshape(-1) for x in jax.tree.leaves(tree)]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def unflatten_like(template: Pytree, flat: jax.Array) -> Pytree:
+    """Inverse of ``flatten_tree``: split ``flat`` back into ``template``'s
+    shapes/dtypes. Extra trailing elements (scatter padding) are ignored."""
+    leaves, treedef = jax.tree.flatten(template)
+    out, off = [], 0
+    for leaf in leaves:
+        size = math.prod(leaf.shape)
+        out.append(flat[off : off + size].reshape(leaf.shape).astype(leaf.dtype))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def _local_weighted_flat_sum(
+    local_stacked: Pytree, local_w: jax.Array
+) -> jax.Array:
+    """One slot's weighted f32 partial sum over its local station block,
+    flattened. Keeps fed_mean's nan-isolation: zero-weight stations are
+    excluded with `where`, so a crashed station's inf/nan cannot poison
+    the aggregate."""
+
+    def leaf_sum(x: jax.Array) -> jax.Array:
+        ww = local_w.reshape((-1,) + (1,) * (x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        safe = jnp.where(ww != 0, xf, jnp.zeros((), jnp.float32))
+        return jnp.sum(safe * ww, axis=0)
+
+    return flatten_tree(
+        [leaf_sum(x) for x in jax.tree.leaves(local_stacked)]
+    )
+
+
+def fed_sum_scattered(
+    mesh: "FederationMesh",
+    stacked: Pytree,
+    weights: jax.Array | None = None,
+    mask: jax.Array | None = None,
+    comm_dtype: Any = None,
+) -> jax.Array:
+    """Weighted sum over stations, reduce-scattered over the station axis.
+
+    Returns ONE flat float32 vector of ``padded_flat_size(N, D)`` elements
+    (N = per-station element count of ``stacked`` minus the leading axis),
+    sharded over the mesh's station axis — slot i holds elements
+    ``[i*N_pad/D, (i+1)*N_pad/D)`` of the global weighted sum. Recover the
+    pytree with ``all_gather_stations`` + ``unflatten_like``.
+
+    Participation ``mask`` / ``weights`` semantics are identical to
+    ``fed_sum``/``fed_mean`` (zero-weight stations nan-isolated). Local
+    accumulation is float32; ``comm_dtype`` narrows only the cross-slot
+    psum_scatter exchange (bf16 halves the on-wire bytes; the D partial
+    sums then combine in bf16 — document the accuracy caveat to callers).
+    """
+    n = _station_count(stacked)
+    if n != mesh.n_stations:
+        raise ValueError(
+            f"stacked has {n} stations but mesh federates {mesh.n_stations}"
+        )
+    w = _norm_weights(n, weights, mask)
+    d = mesh.station_axis_size
+    n_flat = flat_size(jax.tree.map(lambda x: x[0], stacked))
+    pad = padded_flat_size(n_flat, d) - n_flat
+
+    def body(local_stacked: Pytree, local_w: jax.Array) -> jax.Array:
+        flat = _local_weighted_flat_sum(local_stacked, local_w)
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        if comm_dtype is not None:
+            flat = flat.astype(comm_dtype)
+        shard = jax.lax.psum_scatter(
+            flat, STATION_AXIS, scatter_dimension=0, tiled=True
+        )
+        return shard.astype(jnp.float32)
+
+    return station_shard_map(
+        mesh, body,
+        in_specs=(P(STATION_AXIS), P(STATION_AXIS)),
+        out_specs=P(STATION_AXIS),
+    )(stacked, w)
+
+
+def fed_mean_scattered(
+    mesh: "FederationMesh",
+    stacked: Pytree,
+    weights: jax.Array | None = None,
+    mask: jax.Array | None = None,
+    comm_dtype: Any = None,
+) -> jax.Array:
+    """``fed_mean``, reduce-scattered: the FedAvg aggregator returning each
+    slot's 1/D shard of the flat weighted mean (float32 — see
+    ``fed_sum_scattered`` for layout and the ``comm_dtype`` contract).
+
+    The division by effective total weight happens on the f32 shard AFTER
+    the scatter, so the all-dropped guard and dropped-station debiasing
+    match ``fed_mean`` exactly.
+    """
+    n = _station_count(stacked)
+    w = _norm_weights(n, weights, mask)
+    total = jnp.sum(w)
+    denom = jnp.where(total > 0, total, 1.0)
+    s = fed_sum_scattered(mesh, stacked, weights=weights, mask=mask,
+                          comm_dtype=comm_dtype)
+    return s / denom
+
+
+def all_gather_stations(mesh: "FederationMesh", flat: jax.Array) -> jax.Array:
+    """Re-replicate a station-axis-sharded flat vector (the once-per-round
+    all-gather that closes the reduce-scatter -> shard-local update ->
+    all-gather cycle)."""
+
+    def body(local: jax.Array) -> jax.Array:
+        return jax.lax.all_gather(local, STATION_AXIS, tiled=True)
+
+    return station_shard_map(
+        mesh, body, in_specs=(P(STATION_AXIS),), out_specs=P(),
+    )(flat)
+
+
+def fed_mean_scattered_tree(
+    mesh: "FederationMesh",
+    stacked: Pytree,
+    weights: jax.Array | None = None,
+    mask: jax.Array | None = None,
+    comm_dtype: Any = None,
+) -> Pytree:
+    """Convenience: scattered mean -> all-gather -> original pytree shape.
+
+    Communication-equivalent to reduce-scatter + all-gather (i.e. one
+    all-reduce, but with a bf16-narrowable reduce half); result leaves are
+    float32 cast back to each leaf's dtype.
+    """
+    flat = all_gather_stations(
+        mesh,
+        fed_mean_scattered(mesh, stacked, weights=weights, mask=mask,
+                           comm_dtype=comm_dtype),
+    )
+    template = jax.tree.map(lambda x: x[0], stacked)
+    return unflatten_like(template, flat)
 
 
 # --------------------------------------------------------------------------

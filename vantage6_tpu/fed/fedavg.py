@@ -28,7 +28,15 @@ import jax.numpy as jnp
 import optax
 
 from vantage6_tpu.core.mesh import FederationMesh
-from vantage6_tpu.fed.collectives import fed_mean
+from vantage6_tpu.fed.collectives import (
+    all_gather_stations,
+    fed_mean,
+    fed_mean_scattered,
+    flat_size,
+    flatten_tree,
+    padded_flat_size,
+    unflatten_like,
+)
 
 Pytree = Any
 # loss_fn(params, batch_x, batch_y, example_weights) -> scalar mean loss
@@ -42,6 +50,17 @@ class FedAvgSpec:
     batch_size: int = 32
     local_lr: float = 0.1
     server_optimizer: optax.GradientTransformation | None = None  # default sgd(1)
+    # Sharded server update (ZeRO-1 over the station axis): the pseudo-
+    # gradient is reduce-scattered, server-optimizer moments and the optax
+    # update live only on each slot's 1/D flat param shard, and params are
+    # all-gathered once per round. Replicated and sharded modes are
+    # numerically equivalent in f32 (tests/test_scattered_update.py parity).
+    shard_server_update: bool = False
+    # On-wire dtype of the delta reduce-scatter (e.g. jnp.bfloat16 halves
+    # collective bytes). Master params, moments and post-scatter math stay
+    # f32 — see docs/sharded_update.md for the accuracy caveats. Only used
+    # when shard_server_update=True.
+    comm_dtype: Any = None
 
 
 class FedAvg:
@@ -56,6 +75,17 @@ class FedAvg:
         # run_rounds already reuses buffers internally.
         self._round = jax.jit(self._round_impl)
         self._run = jax.jit(self._run_impl, static_argnames=("n_rounds",))
+        # run_rounds IS the multi-round fast path: donating params,
+        # opt_state and the key lets XLA update the scan carry in place
+        # instead of double-buffering model + moments for the whole run.
+        # Kept as a SEPARATE executable so run_rounds(donate=False) (and
+        # AOT callers compiling self._run directly) never consume caller
+        # buffers.
+        self._run_donating = jax.jit(
+            self._run_impl,
+            static_argnames=("n_rounds",),
+            donate_argnums=(0, 1, 6),  # params, opt_state, key
+        )
 
     # ------------------------------------------------------------ local step
     def _local_update(
@@ -111,18 +141,78 @@ class FedAvg:
             replicated_args=(params, round_key),
         )
         weights = counts * mask
-        mean_delta = fed_mean(deltas, weights=weights)
-        # Server update on the pseudo-gradient (negative mean delta).
-        pseudo_grad = jax.tree.map(lambda d: -d, mean_delta)
-        updates, opt_state = self.server_opt.update(
-            pseudo_grad, opt_state, params
-        )
-        params = optax.apply_updates(params, updates)
+        if self.spec.shard_server_update:
+            params, opt_state = self._sharded_server_update(
+                params, opt_state, deltas, weights
+            )
+        else:
+            mean_delta = fed_mean(deltas, weights=weights)
+            # Server update on the pseudo-gradient (negative mean delta).
+            pseudo_grad = jax.tree.map(lambda d: -d, mean_delta)
+            updates, opt_state = self.server_opt.update(
+                pseudo_grad, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
         round_loss = fed_mean(losses, weights=weights)
         return params, opt_state, round_loss
 
+    def _sharded_server_update(
+        self, params: Pytree, opt_state: Any, deltas: Pytree,
+        weights: jax.Array,
+    ) -> tuple[Pytree, Any]:
+        """Reduce-scatter -> shard-local optax update -> all-gather.
+
+        The mean delta is never materialized in full: each slot receives
+        only its 1/D shard of the flat pseudo-gradient (psum_scatter),
+        applies the server optimizer against its 1/D flat param shard —
+        moments in ``opt_state`` are flat [N_pad] vectors sharded the same
+        way (ZeRO-1) — and ONE all-gather re-replicates the updated params
+        for the next round's broadcast.
+        """
+        mesh = self.mesh
+        grad_shard = jax.tree.map(
+            lambda d: -d,
+            fed_mean_scattered(
+                mesh, deltas, weights=weights,
+                comm_dtype=self.spec.comm_dtype,
+            ),
+        )
+        flat_params = flatten_tree(params)
+        n_pad = padded_flat_size(flat_params.size, mesh.station_axis_size)
+        flat_params = jnp.pad(flat_params, (0, n_pad - flat_params.size))
+        # Hold only this slot's shard live: the update below is elementwise,
+        # so GSPMD keeps everything downstream 1/D-sharded too.
+        flat_params = jax.lax.with_sharding_constraint(
+            flat_params, mesh.station_sharding()
+        )
+        updates, opt_state = self.server_opt.update(
+            grad_shard, opt_state, flat_params
+        )
+        new_flat = all_gather_stations(
+            mesh, optax.apply_updates(flat_params, updates)
+        )
+        return unflatten_like(params, new_flat), opt_state
+
     # ------------------------------------------------------------ public API
     def init(self, params: Pytree) -> Any:
+        """Server-optimizer state for ``params``.
+
+        With ``shard_server_update`` the state is built over the FLAT padded
+        f32 param vector (moments are [N_pad] arrays, placed sharded over
+        the station axis) — checkpoints of the two modes are therefore NOT
+        interchangeable.
+        """
+        if self.spec.shard_server_update:
+            flat = flatten_tree(params)
+            n_pad = padded_flat_size(flat.size, self.mesh.station_axis_size)
+            flat = jnp.pad(flat, (0, n_pad - flat.size))
+            state = self.server_opt.init(flat)
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self.mesh.station_sharding())
+                if getattr(x, "ndim", 0) == 1 and x.shape == (n_pad,)
+                else x,
+                state,
+            )
         return self.server_opt.init(params)
 
     def round(
@@ -152,6 +242,7 @@ class FedAvg:
         n_rounds: int,
         mask: jax.Array | None = None,
         opt_state: Any = None,
+        donate: bool = True,
     ):
         """`n_rounds` federated rounds as ONE compiled program (lax.scan) —
         the benchmark fast path. Returns (params, opt_state, losses[n]).
@@ -159,12 +250,21 @@ class FedAvg:
         Pass the ``opt_state`` from a checkpoint to CONTINUE a run (resuming
         FedAdam etc. without resetting server-optimizer moments); omitted, a
         fresh optimizer state is initialized.
+
+        DONATION: by default ``params``, ``opt_state`` and ``key`` buffers
+        are donated — XLA updates the scan carry in place instead of
+        double-buffering model + moments, but the caller's input arrays are
+        CONSUMED and must not be touched again (use the returned values).
+        Pass ``donate=False`` to keep the inputs alive (e.g. ablations
+        re-running several configs from one init). ``round()`` never
+        donates (tests/test_scattered_update.py pins both contracts).
         """
         if mask is None:
             mask = jnp.ones_like(counts)
         if opt_state is None:
             opt_state = self.init(params)
-        return self._run(
+        run = self._run_donating if donate else self._run
+        return run(
             params, opt_state, stacked_x, stacked_y, counts, mask, key,
             n_rounds=n_rounds,
         )

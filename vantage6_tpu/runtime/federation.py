@@ -516,6 +516,54 @@ class Federation:
             i = run.station_index
             run.finish(jax.tree.map(lambda x: x[i], out))
 
+    # --------------------------------------------------- device aggregation
+    def aggregate_stacked(
+        self,
+        task: "Task | int",
+        weights: Any = None,
+        agg_mode: str = "replicated",
+    ) -> Any:
+        """Weighted-mean aggregation of a device-mode task's stacked result,
+        masked by its participation (the central half of a device-mode
+        round, kept on device).
+
+        ``agg_mode``:
+          - ``"replicated"``: ``fed_mean`` — GSPMD all-reduce, the full
+            aggregate materialized on every mesh slot.
+          - ``"scattered"``: reduce-scatter + shard-local divide +
+            all-gather (``fed_mean_scattered_tree``) — per-slot aggregation
+            memory drops to 1/D; f32-equivalent to replicated.
+          - ``"scattered_bf16"``: same, with the delta exchange narrowed to
+            bfloat16 on the wire (see docs/sharded_update.md caveats).
+        """
+        from vantage6_tpu.fed.collectives import (
+            fed_mean,
+            fed_mean_scattered_tree,
+        )
+
+        if isinstance(task, int):
+            task = self.get_task(task)
+        if task.stacked_result is None:
+            raise ValueError(
+                f"task {task.id} has no stacked (device-mode) result"
+            )
+        if agg_mode == "replicated":
+            return fed_mean(
+                task.stacked_result, weights=weights, mask=task.participation
+            )
+        if agg_mode not in ("scattered", "scattered_bf16"):
+            raise ValueError(
+                f"unknown agg_mode {agg_mode!r} (replicated | scattered | "
+                "scattered_bf16)"
+            )
+        return fed_mean_scattered_tree(
+            self.mesh,
+            task.stacked_result,
+            weights=weights,
+            mask=task.participation,
+            comm_dtype=jnp.bfloat16 if agg_mode == "scattered_bf16" else None,
+        )
+
     # ------------------------------------------------------ elastic recovery
     def _drain_pending(self, station: int) -> None:
         """Reference parity: a reconnecting node syncs its missed task queue

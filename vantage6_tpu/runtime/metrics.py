@@ -34,11 +34,33 @@ class MetricsLogger:
         t0 = time.perf_counter()
         yield
         dt = time.perf_counter() - t0
-        self.log("round", round=round_index, seconds=dt,
-                 rounds_per_sec=1.0 / dt if dt > 0 else None)
+        fields: dict[str, Any] = dict(
+            round=round_index, seconds=dt,
+            rounds_per_sec=1.0 / dt if dt > 0 else None,
+        )
+        peak = device_peak_bytes()
+        if peak is not None:
+            fields["device_peak_bytes"] = peak
+        self.log("round", **fields)
 
     def close(self) -> None:
         self._fh.close()
+
+
+def device_peak_bytes(device: Any = None) -> int | None:
+    """Peak device-memory bytes from ``memory_stats()``, or None when the
+    backend doesn't report it (CPU). The ONE memory-observability hook the
+    bench `agg_modes` leg and production `round_timer` records share, so
+    their numbers are comparable."""
+    try:
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+    return int(peak) if peak is not None else None
 
 
 def _tolerant(obj: Any) -> Any:

@@ -1,15 +1,22 @@
 """Input validation schemas for the REST resources.
 
 Parity: the reference validates request bodies with marshmallow schemas
-(SURVEY.md §2 item 5); marshmallow is in the image, so the schemas are real
-marshmallow — one per mutating endpoint, `validate()` raising HTTP 400 via
-the web layer.
+(SURVEY.md §2 item 5) — one per mutating endpoint, `validate()` raising
+HTTP 400 via the web layer. Real marshmallow is preferred when installed;
+environments without it get `_schemas_fallback`, a drop-in implementing
+exactly the subset used here, so input validation (and its 400s) never
+silently disappears with the dependency.
 """
 from __future__ import annotations
 
 from typing import Any
 
-from marshmallow import EXCLUDE, Schema, ValidationError, fields, validate
+try:
+    from marshmallow import EXCLUDE, Schema, ValidationError, fields, validate
+except ModuleNotFoundError:  # pragma: no cover - exercised in CI env
+    from vantage6_tpu.server._schemas_fallback import (  # type: ignore
+        EXCLUDE, Schema, ValidationError, fields, validate,
+    )
 
 from vantage6_tpu.common.enums import TaskStatus
 from vantage6_tpu.server.web import HTTPError

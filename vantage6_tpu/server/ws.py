@@ -20,7 +20,16 @@ import queue
 import threading
 from typing import TYPE_CHECKING, Any
 
-from websockets.sync.server import serve
+# `websockets` is OPTIONAL: the REST cursor remains the full-fidelity event
+# path, so servers without the package simply run pull-only. Import errors
+# surface on bridge construction, not module import.
+try:
+    from websockets.sync.server import serve
+except ModuleNotFoundError as _e:  # pragma: no cover - exercised in CI env
+    serve = None
+    _WEBSOCKETS_ERROR: Exception | None = _e
+else:
+    _WEBSOCKETS_ERROR = None
 
 from vantage6_tpu.common.log import setup_logging
 from vantage6_tpu.server.resources import _rooms_for, identity_from_token
@@ -34,6 +43,12 @@ log = setup_logging("vantage6_tpu/server.ws")
 
 class WebSocketBridge:
     def __init__(self, srv: "ServerApp", host: str = "127.0.0.1", port: int = 0):
+        if _WEBSOCKETS_ERROR is not None:
+            raise RuntimeError(
+                "the 'websockets' package is required for the event push "
+                "bridge but is not installed; nodes fall back to the REST "
+                "event cursor"
+            ) from _WEBSOCKETS_ERROR
         self.srv = srv
         self._server = serve(self._handler, host, port)
         self.host, self.port = self._server.socket.getsockname()[:2]

@@ -133,6 +133,7 @@ def device_logistic_fit(
     local_steps: int = 4,
     batch_rows: int = 64,
     lr: float = 0.5,
+    agg_mode: str = "replicated",
 ) -> dict[str, Any]:
     """Federated logistic regression TRAINED as collective SPMD rounds.
 
@@ -144,6 +145,12 @@ def device_logistic_fit(
 
     ``batch_rows`` is the static per-station row bound (row padding is
     masked out of loss and gradients).
+
+    ``agg_mode`` selects the cross-station merge: ``"replicated"``
+    (GSPMD all-reduce via weighted tensordot), ``"scattered"``
+    (explicit reduce-scatter + all-gather over the inter-daemon fabric —
+    per-slot aggregation memory 1/D), or ``"scattered_bf16"`` (same with
+    the model exchange narrowed to bf16 on the wire).
     """
     mesh = federation_mesh()
     feats = np.asarray(df[feature_columns], np.float32)
@@ -189,10 +196,22 @@ def device_logistic_fit(
     # the station-sharded GLOBAL arrays must enter the jitted program as
     # ARGUMENTS (a multi-process program cannot close over arrays whose
     # shards live on other hosts' devices)
+    if agg_mode not in ("replicated", "scattered", "scattered_bf16"):
+        raise ValueError(f"unknown agg_mode {agg_mode!r}")
+    comm_dtype = jnp.bfloat16 if agg_mode == "scattered_bf16" else None
+
     def train_impl(params, xs, ys, ms):
         def fed_round(p, _):
             locals_, counts = mesh.fed_map(station_round, xs, ys, ms,
                                            replicated_args=(p,))
+            if agg_mode != "replicated":
+                from vantage6_tpu.fed.collectives import (
+                    fed_mean_scattered_tree,
+                )
+
+                return fed_mean_scattered_tree(
+                    mesh, locals_, weights=counts, comm_dtype=comm_dtype
+                ), None
             total = jnp.maximum(jnp.sum(counts), 1.0)
 
             def wmean(leaf):
@@ -219,4 +238,5 @@ def device_logistic_fit(
         "local_rows": int(n_rows),
         "n_stations": int(mesh.n_stations),
         "process_index": int(jax.process_index()),
+        "agg_mode": agg_mode,
     }

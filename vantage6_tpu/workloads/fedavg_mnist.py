@@ -53,6 +53,8 @@ def make_engine(
     batch_size: int = 32,
     local_lr: float = 0.05,
     server_optimizer: optax.GradientTransformation | None = None,
+    shard_server_update: bool = False,
+    comm_dtype: Any = None,
 ) -> FedAvg:
     return FedAvg(
         mesh,
@@ -62,6 +64,8 @@ def make_engine(
             batch_size=batch_size,
             local_lr=local_lr,
             server_optimizer=server_optimizer,
+            shard_server_update=shard_server_update,
+            comm_dtype=comm_dtype,
         ),
     )
 
