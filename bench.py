@@ -99,6 +99,13 @@ SPMD_CPU_TIMEOUT_S = 900
 # agg_modes leg (sharded server update): 3 modes x (compile + warm +
 # timed chain) of a tiny 8-station/2-round config — ~2-4 min on this host.
 AGG_TIMEOUT_S = 600
+# host_parallel leg (station executor pool): sequential vs pooled host-path
+# rounds/sec at HOST_STATIONS stations with a sleep-padded partial — pure
+# scheduling comparison, seconds of wall-clock, CPU only.
+HOST_TIMEOUT_S = 240
+HOST_STATIONS = 4
+HOST_ROUNDS = 6
+HOST_PAD_S = 0.05
 SPMD_CPU_STATIONS = 4   # degraded-CPU federation size, shared by BOTH legs
 SPMD_CPU_ROUNDS = 2     # degraded-CPU rounds per execution, BOTH legs
 ACC_TOLERANCE = 0.05    # |acc_spmd - acc_baseline| for "accuracy_parity"
@@ -214,6 +221,26 @@ def _run_worker(mode: str, *, force_cpu: bool, timeout_s: float,
         except json.JSONDecodeError:
             continue
     return None, f"{mode}: no json in output"
+
+
+def _flash_armed() -> bool:
+    """Whether the transformer worker will ATTEMPT the compiled Pallas
+    flash kernel: BENCH_FLASH wins when set; unset falls back to the
+    FLASH_ATTEMPT.json graduation record (result.ok on platform "tpu" — a
+    CPU fallback attempt's ok must not arm the kernel). One definition
+    shared by worker_transformer (attempt decision) and main() (crash-retry
+    decision), so the two can never disagree."""
+    env = os.environ.get("BENCH_FLASH")
+    if env is not None:
+        return env == "1"
+    try:
+        with open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "FLASH_ATTEMPT.json"
+        )) as fh:
+            rec = json.load(fh).get("result", {})
+        return bool(rec.get("ok")) and rec.get("platform") == "tpu"
+    except Exception:
+        return False
 
 
 def probe_tpu(timeout_s: float = PROBE_TIMEOUT_S) -> tuple[bool, str]:
@@ -393,22 +420,10 @@ def worker_transformer() -> None:
     # once tools/flash_attempt.py has RECORDED a successful compiled-kernel
     # execution on this hardware (FLASH_ATTEMPT.json result.ok), the kernel
     # is proven safe here and becomes the default (BENCH_FLASH=0 still
-    # force-disables it).
-    flash_default = "0"
-    try:
-        with open(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "FLASH_ATTEMPT.json"
-        )) as fh:
-            rec = json.load(fh).get("result", {})
-            # the record must prove the kernel on TPU specifically — a CPU
-            # fallback attempt's ok=true must not arm the kernel here
-            if rec.get("ok") and rec.get("platform") == "tpu":
-                flash_default = "1"
-    except Exception:
-        pass
-    want_flash = on_tpu and os.environ.get(
-        "BENCH_FLASH", flash_default
-    ) == "1"
+    # force-disables it). _flash_armed is SHARED with main()'s crash-retry
+    # branch: a default-armed flash crash must retry with the kernel off,
+    # not silently degrade to CPU.
+    want_flash = on_tpu and _flash_armed()
 
     # BENCH_TF_REMAT=1: per-layer rematerialization — activation memory
     # O(1) in depth, ~+1/3 FLOPs; the knob that lets larger batch/seq fit
@@ -704,6 +719,85 @@ def worker_agg() -> None:
             / max(scat["agg_state_bytes_per_device"], 1), 2
         ),
         "platform": jax.devices()[0].platform,
+    }))
+
+
+def worker_hostparallel() -> None:
+    """host_parallel leg: station executor pool vs sequential host dispatch.
+
+    The SAME federation + task sequence runs twice — executor_workers=0
+    (the historical synchronous path) and executor_workers=n_stations — on
+    a sleep-padded partial (sleep(pad) + a small pandas aggregate), so the
+    measured win is SCHEDULING (max-over-stations vs sum-over-stations per
+    round), not compute luck. Reports rounds/sec for both, the speedup, the
+    max-vs-sum round-time decomposition from per-run timestamps, and a
+    bit-exactness parity flag over the two paths' results.
+    """
+    _worker_setup()
+    import pandas as pd
+
+    from vantage6_tpu.algorithm.decorators import data
+    from vantage6_tpu.runtime.federation import federation_from_datasets
+    from vantage6_tpu.runtime.metrics import round_decomposition
+
+    n_st = int(os.environ.get("BENCH_HOST_STATIONS", str(HOST_STATIONS)))
+    rounds = int(os.environ.get("BENCH_HOST_ROUNDS", str(HOST_ROUNDS)))
+    pad = float(os.environ.get("BENCH_HOST_PAD_S", str(HOST_PAD_S)))
+
+    @data(1)
+    def padded_partial(df, pad_s=0.0):
+        time.sleep(pad_s)
+        return {"sum": float(df["x"].sum()), "n": int(len(df))}
+
+    frames = [
+        pd.DataFrame({"x": [float(i * 100 + j) for j in range(64)]})
+        for i in range(n_st)
+    ]
+    algo = {"padded_partial": padded_partial}
+
+    def timed(workers: int):
+        fed = federation_from_datasets(
+            frames, {"bench-host": algo}, executor_workers=workers
+        )
+        results, per_round, last_task = [], [], None
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            r0 = time.perf_counter()
+            last_task = fed.create_task(
+                "bench-host",
+                {"method": "padded_partial", "kwargs": {"pad_s": pad}},
+            )
+            results.append(fed.wait_for_results(last_task.id))
+            per_round.append(time.perf_counter() - r0)
+        dt = time.perf_counter() - t0
+        decomp = round_decomposition(last_task.runs)
+        fed.close()
+        return rounds / dt, _median(per_round), results, decomp
+
+    seq_rps, seq_round_s, seq_results, seq_decomp = timed(0)
+    pool_rps, pool_round_s, pool_results, pool_decomp = timed(n_st)
+    print(json.dumps({
+        "n_stations": n_st,
+        "rounds": rounds,
+        "pad_s_per_station": pad,
+        "sequential_rounds_per_sec": round(seq_rps, 3),
+        "pooled_rounds_per_sec": round(pool_rps, 3),
+        "sequential_round_time_s": round(seq_round_s, 4),
+        "pooled_round_time_s": round(pool_round_s, 4),
+        "speedup_pooled_vs_sequential": round(pool_rps / seq_rps, 2),
+        # max-vs-sum decomposition of the LAST round's runs: the sequential
+        # path pays ~sum_exec_s of wall-clock, the pooled path ~max_exec_s
+        "round_decomposition": {
+            "sequential": {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in seq_decomp.items()
+            },
+            "pooled": {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in pool_decomp.items()
+            },
+        },
+        "results_parity": bool(seq_results == pool_results),
     }))
 
 
@@ -1045,6 +1139,22 @@ def main() -> None:
     legs_done.append(leg_marker("agg", agg, agg_diag))
     emit()
 
+    # ---- host-path executor pool (sequential vs pooled) ---------------
+    # CPU by design: the host path IS the CPU-side pandas/sklearn surface;
+    # force_cpu also keeps the leg off a possibly wedged tunnel entirely.
+    hp, hp_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        hp, hp_diag = _run_worker(
+            "hostparallel", force_cpu=True,
+            timeout_s=leg_timeout(HOST_TIMEOUT_S),
+        )
+    if hp is not None:
+        out["host_parallel"] = hp
+    else:
+        out["host_parallel_error"] = hp_diag
+    legs_done.append(leg_marker("host_parallel", hp, hp_diag))
+    emit()
+
     # ---- MXU utilization metric (transformer) -------------------------
     tf, tf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
     if remaining() > MIN_LEG_S:
@@ -1052,11 +1162,14 @@ def main() -> None:
             "transformer", force_cpu=not tpu_ok,
             timeout_s=leg_timeout(WORKER_TIMEOUT_S),
         )
-    if (tf is None and tpu_ok and os.environ.get("BENCH_FLASH") == "1"
+    if (tf is None and tpu_ok and _flash_armed()
             and remaining() > MIN_LEG_S):
         # the flash attempt may have crashed the worker outright; retry
         # with the kernel disabled before falling back to CPU (pointless
-        # when flash was never enabled — same env would just rerun)
+        # when flash was never armed — same env would just rerun). Armed
+        # covers BOTH BENCH_FLASH=1 and the FLASH_ATTEMPT.json graduation
+        # default: a default-armed flash crash must get its TPU retry too,
+        # not silently degrade to CPU.
         tf, tf_diag = _run_worker(
             "transformer", force_cpu=False,
             timeout_s=leg_timeout(WORKER_TIMEOUT_S),
@@ -1179,6 +1292,7 @@ if __name__ == "__main__":
          "spmd": worker_spmd,
          "agg": worker_agg,
          "baseline": worker_baseline,
+         "hostparallel": worker_hostparallel,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()
     else:

@@ -43,19 +43,24 @@ class AlgorithmClient:
         self.run = _RunSubClient(self)
         self.organization = _OrganizationSubClient(self)
 
-    # Reference signature: wait_for_results(task_id, interval=1) — interval
-    # and timeout are accepted for compatibility (the REST client needs
-    # both; algorithms pass them uniformly) but nothing polls: execution
-    # already happened (host mode) or is an in-flight async device
-    # computation whose handle we return immediately.
+    # Reference signature: wait_for_results(task_id, interval=1). With the
+    # station executor pool these are REAL polling knobs: a task created
+    # with wait=False may still be queued/executing, and this call blocks
+    # (helping the pool when called from inside a pooled run — the nested
+    # fan-out deadlock-avoidance rule, docs/host_executor.md) until its runs
+    # finish or `timeout` passes (TimeoutError, like the reference client).
     def wait_for_results(
         self,
         task_id: int,
         interval: float = 1.0,
-        timeout: float = 600.0,
+        timeout: float | None = None,
     ) -> list[Any]:
-        del interval, timeout
-        return self._fed.wait_for_results(task_id)
+        # timeout default None = block until done, like the reference
+        # client's defaults (and Federation.wait_for_results); pass a value
+        # to opt into TimeoutError-at-deadline polling.
+        return self._fed.wait_for_results(
+            task_id, timeout=timeout, interval=interval
+        )
 
     def wait_for_stacked_result(self, task_id: int) -> tuple[Any, Any]:
         """TPU fast path (no reference equivalent): returns ``(stacked,
@@ -99,6 +104,7 @@ class _TaskSubClient:
         databases: list[dict[str, Any]] | None = None,
         session: int | None = None,
         store_as: str | None = None,
+        wait: bool = True,
         **_compat: Any,
     ) -> dict[str, Any]:
         """Create a subtask on the given organization ids.
@@ -106,6 +112,10 @@ class _TaskSubClient:
         Returns the task as a dict (reference wire shape, incl. ``id``).
         Subtasks inherit the parent's session when none is given, so a
         central function's fan-out reads/writes the same workspace.
+        ``wait=False`` dispatches asynchronously onto the station executor
+        pool and returns immediately — create every subtask first, then
+        collect with ``wait_for_results``, and the fan-out runs in parallel
+        (reference nodes behave exactly this way).
         """
         parent = self._p._task
         image = parent.image if parent else self._p._image
@@ -125,6 +135,7 @@ class _TaskSubClient:
             parent=parent,
             session=session,
             store_as=store_as,
+            wait=wait,
         )
         return task.to_dict()
 

@@ -95,6 +95,13 @@ class FederationConfig:
     name: str = "federation"
     encrypted: bool = False
     devices_per_station: int = 1
+    # Host-path station executor pool (runtime.executor.StationExecutor):
+    #   None -> default min(n_stations, os.cpu_count()) worker threads;
+    #   0    -> fully synchronous dispatch (the deterministic-debug escape
+    #           hatch — today's sequential semantics, no threads at all);
+    #   N>0  -> exactly N worker threads (per-station serialization holds
+    #           at any size).
+    executor_workers: int | None = None
     stations: list[StationConfig] = dataclasses.field(default_factory=list)
     server: dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -102,9 +109,19 @@ class FederationConfig:
     def n_stations(self) -> int:
         return len(self.stations)
 
+    def resolved_executor_workers(self) -> int:
+        """The effective pool size (0 = synchronous)."""
+        if self.executor_workers is not None:
+            return self.executor_workers
+        return min(self.n_stations, os.cpu_count() or 1)
+
     def validate(self) -> None:
         if not self.stations:
             raise ConfigurationError("federation needs at least one station")
+        if self.executor_workers is not None and self.executor_workers < 0:
+            raise ConfigurationError(
+                "executor_workers must be >= 0 (0 = synchronous dispatch)"
+            )
         names = [s.name for s in self.stations]
         if len(names) != len(set(names)):
             raise ConfigurationError("duplicate station names")
@@ -135,10 +152,12 @@ class FederationConfig:
                     policies=s.get("policies", {}) or {},
                 )
             )
+        workers = fed.get("executor_workers")
         cfg = cls(
             name=fed.get("name", "federation"),
             encrypted=bool(fed.get("encrypted", False)),
             devices_per_station=int(fed.get("devices_per_station", 1)),
+            executor_workers=None if workers is None else int(workers),
             stations=stations,
             server=raw.get("server", {}) or {},
         )
@@ -159,6 +178,7 @@ class FederationConfig:
                 "name": self.name,
                 "encrypted": self.encrypted,
                 "devices_per_station": self.devices_per_station,
+                "executor_workers": self.executor_workers,
             },
             "server": self.server,
             "stations": [

@@ -196,6 +196,21 @@ class FederationMesh:
             **_NO_VMA_KW,
         )(*stacked_args, *replicated_args)
 
+    def fingerprint(self) -> tuple:
+        """Hashable identity of everything a compiled runner depends on:
+        station count, mesh factorization, and the exact device placement.
+        Two meshes with equal fingerprints produce identical shardings, so
+        jitted programs traced against one are reusable with the other —
+        the key workload runner caches (glm/quantiles) use instead of mesh
+        OBJECT identity, which would recompile (and leak a cache entry) for
+        every fresh FederationMesh over the same devices."""
+        return (
+            self.n_stations,
+            self.station_axis_size,
+            self.devices_per_station,
+            tuple(d.id for d in self.mesh.devices.flat),
+        )
+
     def __repr__(self) -> str:  # pragma: no cover
         return (
             f"FederationMesh(S={self.n_stations}, "

@@ -194,6 +194,24 @@ class NodeDaemon:
     ) -> Any:
         return self._rest.request(method, endpoint, json_body, params)
 
+    def _iter_pages(self, endpoint: str, params: dict[str, Any] | None = None):
+        """Yield every item of a paginated listing (full page drain, 250 a
+        page) — the ONE pagination loop the read-only listing sweeps share.
+        The orphan pass in `_sync_missed_runs_locked` keeps its own loop: it
+        MUTATES the filtered set mid-drain and needs page-reset control."""
+        page = 1
+        while True:
+            body = self.request(
+                "GET", endpoint,
+                params={**(params or {}), "per_page": 250, "page": page},
+            )
+            data = body.get("data", [])
+            yield from data
+            total = body.get("pagination", {}).get("total", 0)
+            if page * 250 >= total or not data:
+                return
+            page += 1
+
     def _refresh(self) -> bool:
         if self._refresh_token:
             try:
@@ -216,11 +234,17 @@ class NodeDaemon:
             data = self._post_raw(
                 "token/node", {"api_key": self.api_key}, auth=False
             )
+            # inside the try: a token response missing a key must fail-soft
+            # to False (the documented contract), not raise KeyError out of
+            # the request path; a response without refresh_token keeps the
+            # old one rather than clearing it
+            self._access_token = data["access_token"]
+            self._refresh_token = data.get(
+                "refresh_token", self._refresh_token
+            )
         except Exception as e:
             log.warning("node re-authentication failed: %s", e)
             return False
-        self._access_token = data["access_token"]
-        self._refresh_token = data["refresh_token"]
         log.info("re-authenticated with api_key (refresh token rejected — "
                  "server restart?)")
         return True
@@ -460,29 +484,16 @@ class NodeDaemon:
             # queue order suffices — skip the server scan entirely
             return None
         candidates: list[tuple[int, int]] = []
-        page = 1
-        while True:
-            try:
-                body = self.request(
-                    "GET",
-                    "run",
-                    params={
-                        "status": TaskStatus.PENDING.value,
-                        "per_page": 250,
-                        "page": page,
-                    },
-                )
-            except Exception:
-                return None  # can't consult the server: local order only
-            for run in body.get("data", []):
+        try:
+            for run in self._iter_pages(
+                "run", {"status": TaskStatus.PENDING.value}
+            ):
                 tid = (run.get("task") or {}).get("id")
                 if tid is None or tid >= task_id or run["id"] in attempted:
                     continue
                 candidates.append((tid, run["id"]))
-            total = body.get("pagination", {}).get("total", 0)
-            if page * 250 >= total or not body.get("data"):
-                break
-            page += 1
+        except Exception:
+            return None  # can't consult the server: local order only
         for tid, rid in sorted(candidates):
             engine = engine_cache.get(tid)
             if engine is None:
@@ -560,19 +571,7 @@ class NodeDaemon:
     def _all_task_runs(self, task_id: int) -> list[dict[str, Any]]:
         """EVERY run of a task (full page drain — a >250-org collaboration
         must not hide still-pending peers behind page 1)."""
-        out: list[dict[str, Any]] = []
-        page = 1
-        while True:
-            body = self.request(
-                "GET",
-                f"task/{task_id}/run",
-                params={"per_page": 250, "page": page},
-            )
-            out.extend(body["data"])
-            total = body.get("pagination", {}).get("total", len(out))
-            if page * 250 >= total or not body["data"]:
-                return out
-            page += 1
+        return list(self._iter_pages(f"task/{task_id}/run"))
 
     def _sync_missed_runs(self) -> None:
         """Reference: sync_task_queue_with_server — reclaim every run this
@@ -598,12 +597,14 @@ class NodeDaemon:
     def _sync_kills(self) -> None:
         """Re-learn kills this node may have missed (post-restart heal):
         the kill-task EVENT is the only push channel, so after a cursor
-        reset the killed set is rebuilt from the server's run statuses."""
-        body = self.request(
-            "GET", "run",
-            params={"status": TaskStatus.KILLED.value, "per_page": 250},
-        )
-        for run in body["data"]:
+        reset the killed set is rebuilt from the server's run statuses.
+        Drains EVERY page like the other listings here — the listing is
+        id-ascending, so with >250 historical kills the RECENT ones (the
+        dangerous ones: their runs may still be executing locally) would
+        hide behind page 1."""
+        for run in self._iter_pages(
+            "run", {"status": TaskStatus.KILLED.value}
+        ):
             self._killed.add(run["id"])
 
     def _sync_missed_runs_locked(self) -> None:

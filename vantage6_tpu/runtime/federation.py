@@ -23,6 +23,8 @@ Two execution modes per partial function:
 from __future__ import annotations
 
 import fnmatch
+import threading
+import time
 import traceback
 from types import ModuleType
 from typing import Any, Callable
@@ -40,6 +42,7 @@ from vantage6_tpu.algorithm.decorators import is_v6t_function
 from vantage6_tpu.common.enums import TaskStatus
 from vantage6_tpu.core.config import DatabaseConfig, FederationConfig
 from vantage6_tpu.core.mesh import FederationMesh, Station
+from vantage6_tpu.runtime.executor import StationExecutor
 from vantage6_tpu.runtime.task import Run, Task, new_run, new_task
 
 
@@ -55,9 +58,13 @@ class Federation:
         config: FederationConfig,
         devices: Any = None,
         algorithms: dict[str, ModuleType | dict[str, Callable]] | None = None,
+        metrics: Any = None,
     ):
         config.validate()
         self.config = config
+        # optional MetricsLogger: host runs emit queued→started→finished
+        # lifecycle events so stragglers are visible (runtime.metrics)
+        self.metrics = metrics
         self.mesh = FederationMesh(
             config.n_stations,
             devices=devices,
@@ -96,6 +103,31 @@ class Federation:
         for image, mod in (algorithms or {}).items():
             self.register_algorithm(image, mod)
         self.tasks: dict[int, Task] = {}
+        # ------------------------------------------------ host executor pool
+        # Host-mode runs dispatch onto a StationExecutor (per-station FIFO
+        # serialization over a shared thread pool); 0 workers = today's
+        # fully synchronous dispatch. Concurrency makes these shared
+        # structures contended — each gets its own lock:
+        workers = config.resolved_executor_workers()
+        self._executor: StationExecutor | None = (
+            StationExecutor(config.n_stations, workers) if workers > 0 else None
+        )
+        if self._executor is not None:
+            # abandoned Federations (construction sites predating close())
+            # must not leak pool threads: tear the executor down at GC.
+            # finalize refs the EXECUTOR, not self — no resurrection cycle.
+            import weakref
+
+            self._executor_finalizer = weakref.finalize(
+                self, StationExecutor.close, self._executor
+            )
+        # run ids queued/executing on the pool (NOT the same as PENDING:
+        # a PENDING run on an offline station is owed, not in flight)
+        self._inflight_runs: set[int] = set()
+        self._inflight_lock = threading.Lock()
+        self._stacked_lock = threading.Lock()   # _stacked_cache builds
+        self._identity_lock = threading.Lock()  # lazy RSA keygen
+        self._session_lock = threading.Lock()   # session bookkeeping
 
     # ------------------------------------------------------------------ data
     def load_all_data(self) -> None:
@@ -129,11 +161,14 @@ class Federation:
         Device-mode partials consume this; requires homogeneous shapes (pad +
         mask ragged data upstream — see fed.collectives participation masks).
         """
-        if label not in self._stacked_cache:
-            per = [self.station_data(i, label) for i in range(self.n_stations)]
-            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
-            self._stacked_cache[label] = self.mesh.shard_stacked(stacked)
-        return self._stacked_cache[label]
+        with self._stacked_lock:
+            if label not in self._stacked_cache:
+                per = [
+                    self.station_data(i, label) for i in range(self.n_stations)
+                ]
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+                self._stacked_cache[label] = self.mesh.shard_stacked(stacked)
+            return self._stacked_cache[label]
 
     # ------------------------------------------------------------ algorithms
     def register_algorithm(
@@ -220,13 +255,20 @@ class Federation:
         init_user: str = "",
         session: int | None = None,
         store_as: str | None = None,
+        wait: bool = True,
     ) -> Task:
         """Create + dispatch a task (reference: POST /api/task + fan-out).
 
         ``input_`` is the reference's wire shape: ``{"method", "args",
-        "kwargs"}``. Execution is synchronous (statuses still transition
-        PENDING→ACTIVE→COMPLETED so observing code ports); offline stations
-        keep their runs PENDING until `set_station_online` drains them.
+        "kwargs"}``. Host-mode runs dispatch onto the station executor pool
+        (per-station serialization; docs/host_executor.md); with the default
+        ``wait=True`` this call blocks until every dispatched run reached a
+        terminal state, so statuses observed afterwards match the historical
+        synchronous behavior. ``wait=False`` returns immediately with the
+        dispatched runs in flight (PENDING until a worker starts them, then
+        ACTIVE) — poll with ``wait_for_results(timeout=..., interval=...)``.
+        Offline stations keep their runs PENDING (not in flight) until
+        `set_station_online` drains them, in both modes.
         """
         method = input_.get("method")
         if not method:
@@ -287,24 +329,92 @@ class Federation:
         ]
         self.tasks[task.id] = task
         self._dispatch(task)
+        if wait:
+            self._await_inflight(task.runs)
         return task
 
     def get_task(self, task_id: int) -> Task:
         return self.tasks[task_id]
 
     def kill_task(self, task_id: int) -> None:
-        """Parity: the server's `kill` SocketIO event."""
-        for r in self.tasks[task_id].runs:
-            if not r.status.is_finished:
-                r.status = TaskStatus.KILLED
+        """Parity: the server's `kill` SocketIO event.
 
-    def wait_for_results(self, task_id: int) -> list[Any]:
+        Under the executor pool this also interrupts QUEUED runs mid-flight:
+        a killed run's queue item is skipped when a worker pops it (terminal
+        states are sticky — see Run), and a run killed while executing has
+        its late result dropped by `Run.finish`.
+        """
+        for r in self.tasks[task_id].runs:
+            r.kill()
+
+    # ------------------------------------------------------------- wait loop
+    def _runs_in_flight(self, runs: list[Run]) -> list[Run]:
+        with self._inflight_lock:
+            return [r for r in runs if r.id in self._inflight_runs]
+
+    def _await_inflight(
+        self,
+        runs: list[Run],
+        timeout: float | None = None,
+        interval: float = 0.1,
+        task_id: int | None = None,
+        stop_on_failure: bool = False,
+    ) -> None:
+        """Wait until none of ``runs`` is queued/executing on the pool.
+
+        Inside an executor worker (a central partial waiting on its
+        subtasks) each iteration lends the thread to queued work
+        (StationExecutor.help_or_wait) — the rule that makes nested
+        ``create_task`` deadlock-free at any pool size. ``stop_on_failure``
+        returns early as soon as any run fails (wait_for_results raises on
+        the failure without draining siblings first).
+        """
+        if self._executor is None:
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if stop_on_failure and any(r.status.has_failed for r in runs):
+                return
+            busy = self._runs_in_flight(runs)
+            if not busy:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                stations = sorted({r.organization for r in busy})
+                raise TimeoutError(
+                    f"task {task_id if task_id is not None else busy[0].task_id}"
+                    f" still running at {stations} after {timeout}s"
+                )
+            step = interval
+            if deadline is not None:
+                step = max(1e-3, min(interval, deadline - time.monotonic()))
+            executor = self._executor  # close() may null it mid-wait
+            if executor is None:
+                raise RuntimeError(
+                    "federation closed while waiting for runs "
+                    f"{[r.id for r in busy]} — their queued work was dropped"
+                )
+            executor.help_or_wait(step)
+
+    def wait_for_results(
+        self,
+        task_id: int,
+        timeout: float | None = None,
+        interval: float = 0.1,
+    ) -> list[Any]:
         """Fetch results of finished runs (reference: poll /api/result).
 
-        Raises if the task failed; PENDING runs on offline stations raise a
-        RuntimeError naming the stations still owed a result.
+        Blocks while the task's runs are queued/executing on the executor
+        pool (``timeout``/``interval`` give the reference client's polling
+        semantics; TimeoutError when the deadline passes first). Raises if
+        the task failed; PENDING runs on offline stations — owed, not in
+        flight — raise a RuntimeError naming the stations still owed a
+        result.
         """
         task = self.tasks[task_id]
+        self._await_inflight(
+            task.runs, timeout=timeout, interval=interval, task_id=task_id,
+            stop_on_failure=True,
+        )
         bad = [r for r in task.runs if r.status.has_failed]
         if bad:
             r = bad[0]
@@ -357,22 +467,49 @@ class Federation:
         if not runnable or fn is None:
             return
         if getattr(fn, "__v6t_device_step__", False):
+            # device mode stays synchronous: all stations already execute as
+            # ONE SPMD program — there is nothing to parallelize host-side
             self._run_device_step(task, fn, runnable)
-        else:
+        elif self._executor is None:
             for run in runnable:
                 self._run_host(task, fn, run)
+        else:
+            for run in runnable:
+                self._submit_host_run(task, fn, run)
+
+    def _submit_host_run(self, task: Task, fn: Callable, run: Run) -> None:
+        """Queue one host-mode run on the station executor (per-station FIFO
+        — two runs never execute concurrently on one station)."""
+        run.mark_queued()
+        with self._inflight_lock:
+            self._inflight_runs.add(run.id)
+
+        def item() -> None:
+            try:
+                # killed while queued: skip without ever going ACTIVE
+                if not run.status.is_finished:
+                    self._run_host(task, fn, run)
+            finally:
+                with self._inflight_lock:
+                    self._inflight_runs.discard(run.id)
+
+        self._executor.submit(run.station_index, item)
 
     # -------------------------------------------------------------- identity
     def _station_identity(self, station: int):
         """This station's org RSA identity cryptor (lazy keygen, cached) —
         each real node would hold its own key file; the simulator generates
-        one per station the first time an algorithm signs."""
+        one per station the first time an algorithm signs. Keygen is locked:
+        concurrent pooled runs must not both generate (and then disagree on)
+        a station's identity."""
         if self._identity_cryptors[station] is None:
             from vantage6_tpu.common.encryption import RSACryptor
 
-            self._identity_cryptors[station] = RSACryptor(
-                RSACryptor.create_new_rsa_key()
-            )
+            with self._identity_lock:
+                if self._identity_cryptors[station] is None:
+                    self._identity_cryptors[station] = RSACryptor(
+                        RSACryptor.create_new_rsa_key()
+                    )
         return self._identity_cryptors[station]
 
     def _org_identity_registry(self) -> dict[int, str]:
@@ -409,6 +546,8 @@ class Federation:
                 f"task stores dataframe {task.store_as!r} but the algorithm"
                 f" returned {type(result).__name__}, not a DataFrame"
             )
+        # the dataframe store itself is per-station (executor serializes the
+        # station), but the session BOOKKEEPING is shared across stations
         self._session_stores[run.station_index].setdefault(
             task.session_id, {}
         )[task.store_as] = df
@@ -421,22 +560,32 @@ class Federation:
                 for c, t in df.dtypes.items()
             ],
         }
-        # ready only when EVERY station's run completed (this run's finish
-        # is recorded by the caller right after, so count it as done)
-        others_done = all(
-            r.status == TaskStatus.COMPLETED or r.id == run.id
-            for r in task.runs
-        )
-        book = self._sessions[task.session_id]["dataframes"][task.store_as]
-        book["columns"] = meta["columns"]
-        book["ready"] = others_done
+        with self._session_lock:
+            book = self._sessions[task.session_id]["dataframes"][task.store_as]
+            book["columns"] = meta["columns"]
         return meta
+
+    def _refresh_session_ready(self, task: Task) -> None:
+        """ready = EVERY station's run completed. Evaluated AFTER each run's
+        finish (not inside _store_session_result): with pooled execution two
+        stations finishing concurrently would each see the other still
+        ACTIVE and neither would flip the flag."""
+        with self._session_lock:
+            session = self._sessions.get(task.session_id)
+            if session is None:  # deleted mid-run
+                return
+            book = session["dataframes"].get(task.store_as)
+            if book is not None:
+                book["ready"] = all(
+                    r.status == TaskStatus.COMPLETED for r in task.runs
+                )
 
     # ------------------------------------------------------------- host mode
     def _run_host(self, task: Task, fn: Callable, run: Run) -> None:
         from vantage6_tpu.algorithm.client import AlgorithmClient
 
-        run.start()
+        if not run.start():
+            return  # killed between queue-pop and start
         try:
             frames = [
                 self._resolve_frame(task, run.station_index, d)
@@ -468,9 +617,25 @@ class Federation:
                 result = fn(*args, **kwargs)
             if task.store_as:
                 result = self._store_session_result(task, run, result)
-            run.finish(result)
+            if run.finish(result):
+                if task.store_as:
+                    self._refresh_session_ready(task)
+            elif task.store_as:
+                # killed mid-execution: finish() dropped the result, so the
+                # already-committed dataframe must not stay readable either
+                # — store state and run status would otherwise disagree
+                self._session_stores[run.station_index].get(
+                    task.session_id, {}
+                ).pop(task.store_as, None)
         except Exception:
             run.crash(traceback.format_exc(limit=8))
+        finally:
+            if self.metrics is not None:
+                from vantage6_tpu.runtime.metrics import run_lifecycle
+
+                self.metrics.log(
+                    "host_run", task_id=task.id, **run_lifecycle(run)
+                )
 
     # ----------------------------------------------------------- device mode
     def _run_device_step(
@@ -567,8 +732,16 @@ class Federation:
     # ------------------------------------------------------ elastic recovery
     def _drain_pending(self, station: int) -> None:
         """Reference parity: a reconnecting node syncs its missed task queue
-        (`sync_task_queue_with_server`) and executes what it owes."""
-        for task in self.tasks.values():
+        (`sync_task_queue_with_server`) and executes what it owes. Host runs
+        drain through the executor pool (per-station FIFO keeps them ordered
+        after anything already queued); the call blocks until the owed runs
+        finished, so `set_station_online` keeps its synchronous contract."""
+        owed: list[Run] = []
+        with self._inflight_lock:
+            already = set(self._inflight_runs)
+        # snapshot: pool workers insert nested tasks concurrently, and a
+        # live dict iteration would die with "changed size during iteration"
+        for task in list(self.tasks.values()):
             fn = self.resolve_function(task.image, task.method)
             if fn is None:
                 continue
@@ -576,11 +749,42 @@ class Federation:
                 if (
                     run.station_index == station
                     and run.status == TaskStatus.PENDING
+                    and run.id not in already
                 ):
                     if getattr(fn, "__v6t_device_step__", False):
                         self._run_device_step(task, fn, [run])
-                    else:
+                    elif self._executor is None:
                         self._run_host(task, fn, run)
+                    else:
+                        self._submit_host_run(task, fn, run)
+                        owed.append(run)
+        if owed:
+            self._await_inflight(owed)
+
+    # --------------------------------------------------------- observability
+    def task_timing(self, task_id: int) -> dict[str, Any]:
+        """Per-run queued→started→finished lifecycle plus the max-vs-sum
+        round-time decomposition (straggler view): a parallel round costs
+        max-over-stations, a sequential one sum-over-stations."""
+        from vantage6_tpu.runtime.metrics import (
+            round_decomposition,
+            run_lifecycle,
+        )
+
+        task = self.tasks[task_id]
+        return {
+            "task_id": task_id,
+            "runs": [run_lifecycle(r) for r in task.runs],
+            **round_decomposition(task.runs),
+        }
+
+    # -------------------------------------------------------------- teardown
+    def close(self) -> None:
+        """Tear down the executor pool (queued-but-unstarted runs are
+        dropped). Idempotent; the Federation stays readable."""
+        if self._executor is not None:
+            self._executor.close()
+            self._executor = None
 
 
 def federation_from_datasets(
@@ -589,13 +793,17 @@ def federation_from_datasets(
     label: str = "default",
     devices: Any = None,
     name: str = "mock",
+    executor_workers: int | None = None,
 ) -> Federation:
     """Build a ready Federation from in-memory per-station datasets —
-    the MockAlgorithmClient construction path."""
+    the MockAlgorithmClient construction path. ``executor_workers``
+    configures the host-path station executor pool (None = auto,
+    0 = synchronous; see FederationConfig)."""
     from vantage6_tpu.core.config import StationConfig
 
     cfg = FederationConfig(
         name=name,
+        executor_workers=executor_workers,
         stations=[
             StationConfig(
                 name=f"station_{i}",

@@ -47,6 +47,59 @@ class MetricsLogger:
         self._fh.close()
 
 
+def run_lifecycle(run: Any) -> dict[str, Any]:
+    """queued→started→finished decomposition of one host-path Run.
+
+    ``queue_wait_s`` is the time the run sat on the station executor before
+    a worker started it; ``exec_s`` the time inside the algorithm. Both are
+    what the straggler view (``round_decomposition``) aggregates.
+    """
+    out: dict[str, Any] = {
+        "run_id": run.id,
+        "station": run.station_index,
+        "status": getattr(run.status, "value", str(run.status)),
+        "queued_at": run.queued_at,
+        "started_at": run.started_at,
+        "finished_at": run.finished_at,
+    }
+    queued = run.queued_at if run.queued_at is not None else run.assigned_at
+    if run.started_at is not None:
+        out["queue_wait_s"] = max(0.0, run.started_at - queued)
+        if run.finished_at is not None:
+            out["exec_s"] = run.finished_at - run.started_at
+    return out
+
+
+def round_decomposition(runs: list[Any]) -> dict[str, Any]:
+    """Max-vs-sum round-time decomposition over a task's runs.
+
+    A sequential host path pays ``sum_exec_s`` of wall-clock per round; a
+    parallel one pays ``span_s`` (bounded below by ``max_exec_s``, the
+    straggler — per-round wall-clock is max-over-stations, not
+    sum-over-stations). ``parallel_speedup_bound`` = sum/max is the best
+    speedup any scheduler could extract from these runs.
+    """
+    spans = [
+        (r.station_index, r.started_at, r.finished_at)
+        for r in runs
+        if r.started_at is not None and r.finished_at is not None
+    ]
+    if not spans:
+        return {"n_runs_timed": 0}
+    execs = [(s, t1 - t0) for s, t0, t1 in spans]
+    sum_s = sum(dt for _, dt in execs)
+    straggler, max_s = max(execs, key=lambda e: e[1])
+    span = max(t1 for _, _, t1 in spans) - min(t0 for _, t0, _ in spans)
+    return {
+        "n_runs_timed": len(spans),
+        "sum_exec_s": sum_s,
+        "max_exec_s": max_s,
+        "span_s": span,
+        "straggler_station": straggler,
+        "parallel_speedup_bound": sum_s / max_s if max_s > 0 else None,
+    }
+
+
 def device_peak_bytes(device: Any = None) -> int | None:
     """Peak device-memory bytes from ``memory_stats()``, or None when the
     backend doesn't report it (CPU). The ONE memory-observability hook the

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 import time
 from typing import Any
 
@@ -22,7 +23,15 @@ _run_ids = itertools.count(1)
 
 @dataclasses.dataclass
 class Run:
-    """One organization's execution of a task (reference: `Run`, né `Result`)."""
+    """One organization's execution of a task (reference: `Run`, né `Result`).
+
+    Status transitions are thread-safe and terminal-sticky: with the station
+    executor pool a run may be started by a worker thread while `kill_task`
+    flips it to KILLED from another — whoever reaches a terminal state first
+    wins, and a late `finish`/`crash` must NOT overwrite a kill (parity: the
+    server rejects status patches on terminal runs with 409). Each mutator
+    returns whether it applied.
+    """
 
     id: int
     task_id: int
@@ -32,22 +41,54 @@ class Run:
     result: Any = None
     log: str = ""
     assigned_at: float = dataclasses.field(default_factory=time.time)
+    # set when the run is queued onto the station executor pool; together
+    # with started_at/finished_at this gives the queued→started→finished
+    # lifecycle runtime.metrics.run_lifecycle decomposes (straggler view)
+    queued_at: float | None = None
     started_at: float | None = None
     finished_at: float | None = None
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
-    def start(self) -> None:
-        self.status = TaskStatus.ACTIVE
-        self.started_at = time.time()
+    def start(self) -> bool:
+        with self._lock:
+            if self.status.is_finished:
+                return False  # killed while queued: never goes ACTIVE
+            self.status = TaskStatus.ACTIVE
+            self.started_at = time.time()
+            return True
 
-    def finish(self, result: Any) -> None:
-        self.result = result
-        self.status = TaskStatus.COMPLETED
-        self.finished_at = time.time()
+    def finish(self, result: Any) -> bool:
+        with self._lock:
+            if self.status.is_finished:
+                return False  # killed mid-execution: drop the result
+            self.result = result
+            self.status = TaskStatus.COMPLETED
+            self.finished_at = time.time()
+            return True
 
-    def crash(self, log: str) -> None:
-        self.log = log
-        self.status = TaskStatus.CRASHED
-        self.finished_at = time.time()
+    def crash(self, log: str) -> bool:
+        with self._lock:
+            if self.status.is_finished:
+                return False
+            self.log = log
+            self.status = TaskStatus.CRASHED
+            self.finished_at = time.time()
+            return True
+
+    def kill(self) -> bool:
+        """Parity: the server's kill event. Queued (not-yet-started) and
+        ACTIVE runs flip to KILLED; finished runs are immutable."""
+        with self._lock:
+            if self.status.is_finished:
+                return False
+            self.status = TaskStatus.KILLED
+            self.finished_at = time.time()
+            return True
+
+    def mark_queued(self) -> None:
+        self.queued_at = time.time()
 
 
 @dataclasses.dataclass

@@ -202,15 +202,20 @@ def central_glm(
 
 
 # --------------------------------------------------------------- device mode
-import functools
+_GLM_RUNNERS: dict[tuple, Any] = {}
 
 
-@functools.cache
 def _glm_runner(mesh: FederationMesh, family: str, n_iter: int):
-    """Compiled IRLS runner, cached per (mesh, family, n_iter): repeated
-    fits with same-shaped data reuse one executable instead of paying XLA
-    compilation of the whole scan every call. Data enters as ARGUMENTS, not
-    trace constants."""
+    """Compiled IRLS runner, cached per (mesh.fingerprint(), family,
+    n_iter): repeated fits with same-shaped data reuse one executable
+    instead of paying XLA compilation of the whole scan every call — and
+    callers constructing a FRESH FederationMesh over the same devices hit
+    the cache too (object identity would recompile and leak an entry per
+    call). Data enters as ARGUMENTS, not trace constants."""
+    key = (mesh.fingerprint(), family, n_iter)
+    cached = _GLM_RUNNERS.get(key)
+    if cached is not None:
+        return cached
 
     def station_stats(x, y, m, beta):
         eta = x @ beta
@@ -237,7 +242,8 @@ def _glm_runner(mesh: FederationMesh, family: str, n_iter: int):
 
         return jax.lax.scan(one_iter, beta0, None, length=n_iter)
 
-    return jax.jit(run)
+    _GLM_RUNNERS[key] = jax.jit(run)
+    return _GLM_RUNNERS[key]
 
 
 def fit_glm_device(

@@ -160,14 +160,19 @@ def central_quantile(
 
 
 # --------------------------------------------------------------- device mode
-import functools
+_QUANTILE_RUNNERS: dict[tuple, Any] = {}
 
 
-@functools.cache
 def _quantile_runner(mesh: Any, n_iter: int):
-    """Compiled bisection program, cached per (mesh, n_iter) like glm's
-    _glm_runner: q and the bound sentinels enter as TRACED arguments, so
-    one compilation serves every quantile of same-shaped data."""
+    """Compiled bisection program, cached per (mesh.fingerprint(), n_iter)
+    like glm's _glm_runner — a fresh same-shaped FederationMesh reuses the
+    executable instead of recompiling and leaking a cache entry. q and the
+    bound sentinels enter as TRACED arguments, so one compilation serves
+    every quantile of same-shaped data."""
+    key = (mesh.fingerprint(), n_iter)
+    cached = _QUANTILE_RUNNERS.get(key)
+    if cached is not None:
+        return cached
     import jax
     import jax.numpy as jnp
 
@@ -215,7 +220,8 @@ def _quantile_runner(mesh: Any, n_iter: int):
         # bracket evidence for the host-side guards (cannot raise in jit)
         return bhi, n, count_below(lo), count_below(hi)
 
-    return jax.jit(run)
+    _QUANTILE_RUNNERS[key] = jax.jit(run)
+    return _QUANTILE_RUNNERS[key]
 
 
 def quantile_device(

@@ -99,7 +99,9 @@ def central_secure_average(
     # aggregations under one provisioned seed (native.derive_mask_key) —
     # the tag is not secret, it only provides domain separation
     agg_tag = secrets.token_hex(16)
-    # one subtask per org: each party must learn its own party_index
+    # one subtask per org: each party must learn its own party_index.
+    # wait=False: all parties mask CONCURRENTLY on the station executor
+    # pool (create-all-then-collect), like real nodes would
     uploads = []
     subtasks = []
     for idx, org in enumerate(orgs):
@@ -119,6 +121,7 @@ def central_secure_average(
                 },
                 organizations=[org],
                 name=f"secure_partial_{idx}",
+                wait=False,
             )
         )
     for sub in subtasks:
@@ -295,7 +298,7 @@ def central_secure_average_dh(
     scale = 2.0**30 / (n * max_abs)
     agg_tag = secrets.token_hex(16)
 
-    # round 1: collect per-aggregation public keys
+    # round 1: collect per-aggregation public keys (parallel fan-out)
     adverts = []
     for idx, org in enumerate(orgs):
         adverts.append(
@@ -306,6 +309,7 @@ def central_secure_average_dh(
                 },
                 organizations=[org],
                 name=f"dh_advertise_{idx}",
+                wait=False,
             )
         )
     pubkeys: list[list[Any]] = []
@@ -338,6 +342,7 @@ def central_secure_average_dh(
                 },
                 organizations=[org],
                 name=f"dh_secure_partial_{idx}",
+                wait=False,
             )
         )
     uploads = []
@@ -529,6 +534,10 @@ def central_secure_average_bonawitz(
                         },
                         organizations=[org],
                         name=f"{name}_{idx}",
+                        # all parties run each protocol round concurrently;
+                        # collect() polls afterwards (dropout discovery in
+                        # round 3 relies on wait_for_results' timeout)
+                        wait=False,
                     ),
                 )
             )
