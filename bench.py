@@ -103,6 +103,15 @@ AGG_TIMEOUT_S = 600
 # rounds/sec at HOST_STATIONS stations with a sleep-padded partial — pure
 # scheduling comparison, seconds of wall-clock, CPU only.
 HOST_TIMEOUT_S = 240
+# wire_format leg (binary wire PR): v1 JSON+base64 vs v2 framed-binary
+# (de)serialization throughput + on-wire bytes on model-weight pytrees and a
+# DataFrame stats table, plus single-pass broadcast encryption cost when the
+# cryptography package is present (4096-bit keygen is seconds; AES of the
+# payloads is milliseconds). Pure host CPU work.
+WIRE_TIMEOUT_S = 300
+WIRE_MB_SIZES = (1, 10, 32)   # pytree payload sizes (MiB of f32 weights)
+WIRE_REPS = 3                 # timed reps per measurement (median-free mean)
+WIRE_BROADCAST_N = 8          # acceptance: broadcast-to-8 within 2x single
 HOST_STATIONS = 4
 HOST_ROUNDS = 6
 HOST_PAD_S = 0.05
@@ -801,6 +810,193 @@ def worker_hostparallel() -> None:
     }))
 
 
+def worker_wireformat() -> None:
+    """wire_format leg: v1 (JSON + base64 .npy) vs v2 (framed binary) wire.
+
+    Serialization: model-weight-like f32 pytrees at WIRE_MB_SIZES MiB and a
+    DataFrame stats table through serialize+deserialize in BOTH formats —
+    reports encode+decode throughput, on-wire bytes, and the reduction; the
+    parity block asserts v2 round-trips bit-identically AND that v1 blobs
+    still decode through the auto-detecting deserialize.
+
+    Encryption (cryptography-gated, skipped with a marker otherwise): one
+    RSA keypair, then single-recipient encrypt vs `encrypt_bytes_broadcast`
+    to WIRE_BROADCAST_N recipients vs N naive full passes on the 10 MiB
+    payload; also decrypts a legacy '$'-format blob with the v2-capable
+    cryptor (cross-format compat).
+    """
+    _worker_setup()
+    import numpy as np
+
+    from vantage6_tpu.common.serialization import deserialize, serialize
+
+    rng = np.random.default_rng(0)
+
+    def pytree_payload(mib: float) -> dict:
+        """4-layer weight pytree totalling ~mib MiB of f32."""
+        n = int(mib * (1 << 20) / 4)
+        quarter = max(1, n // 4)
+        return {
+            "round": 7,
+            "layers": {
+                f"layer_{i}": {
+                    "w": rng.standard_normal(quarter, dtype=np.float32),
+                    "b": rng.standard_normal(
+                        max(1, quarter // 64), dtype=np.float32
+                    ),
+                }
+                for i in range(4)
+            },
+        }
+
+    def tree_equal(a, b) -> bool:
+        if isinstance(a, dict):
+            return (isinstance(b, dict) and a.keys() == b.keys()
+                    and all(tree_equal(a[k], b[k]) for k in a))
+        if isinstance(a, np.ndarray):
+            return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                    and a.shape == b.shape
+                    and bool(np.array_equal(a, b, equal_nan=True)))
+        return type(a) is type(b) and a == b
+
+    def timed(fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(WIRE_REPS):
+            fn()
+        return (time.perf_counter() - t0) / WIRE_REPS
+
+    sizes_out = []
+    parity_all = True
+    for mib in WIRE_MB_SIZES:
+        payload = pytree_payload(mib)
+        v1 = serialize(payload, format="v1")
+        v2 = serialize(payload, format="v2")
+        enc1 = timed(lambda: serialize(payload, format="v1"))
+        enc2 = timed(lambda: serialize(payload, format="v2"))
+        dec1 = timed(lambda: deserialize(v1))
+        dec2 = timed(lambda: deserialize(v2))
+        payload_mb = mib  # nominal f32 MiB
+        parity = (
+            tree_equal(deserialize(v2), payload)   # v2 bit-identical
+            and tree_equal(deserialize(v1), payload)  # v1 still decodes
+        )
+        parity_all = parity_all and parity
+        sizes_out.append({
+            "payload_mib": payload_mb,
+            "v1_bytes": len(v1),
+            "v2_bytes": len(v2),
+            "on_wire_reduction": round(1.0 - len(v2) / len(v1), 4),
+            "v1_encode_s": round(enc1, 5), "v1_decode_s": round(dec1, 5),
+            "v2_encode_s": round(enc2, 5), "v2_decode_s": round(dec2, 5),
+            "roundtrip_speedup_v2_vs_v1": round(
+                (enc1 + dec1) / max(enc2 + dec2, 1e-9), 1
+            ),
+            "v2_roundtrip_mb_per_s": round(
+                2 * payload_mb / max(enc2 + dec2, 1e-9), 1
+            ),
+            "parity": parity,
+        })
+
+    # DataFrame stats table (per-station summary shape)
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "feature": [f"f{i}" for i in range(200)],
+        "mean": rng.standard_normal(200),
+        "std": rng.standard_normal(200) ** 2,
+        "count": rng.integers(0, 10**6, 200),
+    })
+    df_payload = {"stats": df, "n": 200}
+    df_ok = True
+    for fmt in ("v1", "v2"):
+        out = deserialize(serialize(df_payload, format=fmt))
+        try:
+            # to_json carries 10 decimal digits (both formats — DataFrames
+            # ride the header): near-exact, not bit-exact, by design
+            pd.testing.assert_frame_equal(
+                out["stats"], df, check_exact=False, rtol=1e-9
+            )
+            df_ok = df_ok and out["n"] == 200
+        except AssertionError:
+            df_ok = False
+
+    # headline acceptance numbers come from the >=10 MiB payload
+    big = next(s for s in sizes_out if s["payload_mib"] >= 10)
+
+    # ---- encryption: single vs single-pass broadcast ------------------
+    crypto: dict = {}
+    try:
+        import cryptography  # noqa: F401
+        have_crypto = True
+    except ImportError:
+        have_crypto = False
+        crypto["skipped"] = "cryptography not installed"
+    if have_crypto:
+        from vantage6_tpu.common.encryption import RSACryptor
+
+        t0 = time.perf_counter()
+        kp = RSACryptor(RSACryptor.create_new_rsa_key())
+        keygen_s = time.perf_counter() - t0
+        pub = kp.public_key_str
+        data = serialize(pytree_payload(10), format="v2")
+        t_single = timed(lambda: kp.encrypt_bytes(data, pub))
+        t_bcast = timed(
+            lambda: kp.encrypt_bytes_broadcast(data, [pub] * WIRE_BROADCAST_N)
+        )
+        t_naive = timed(
+            lambda: [kp.encrypt_bytes(data, pub)
+                     for _ in range(WIRE_BROADCAST_N)]
+        )
+        blob_bin = kp.encrypt_bytes(data, pub)
+        wire_v2_str = kp.encrypt_bytes_to_str(data, pub)
+        legacy_str = kp._encrypt_legacy_str(data, pub)
+        compat = (
+            kp.decrypt_bytes(blob_bin) == data
+            and kp.decrypt_str_to_bytes(wire_v2_str) == data
+            and kp.decrypt_bytes(legacy_str) == data      # v1 encrypted blob
+        )
+        # legacy double-encoding comparison on the STRING wire: v1 payload
+        # inside the legacy cryptor vs v2 payload inside the binary framing
+        v1_payload = serialize(pytree_payload(10), format="v1")
+        legacy_wire_len = len(kp._encrypt_legacy_str(v1_payload, pub))
+        crypto = {
+            "keygen_s": round(keygen_s, 2),
+            "payload_bytes": len(data),
+            "single_encrypt_s": round(t_single, 4),
+            f"broadcast_{WIRE_BROADCAST_N}_s": round(t_bcast, 4),
+            f"naive_{WIRE_BROADCAST_N}x_s": round(t_naive, 4),
+            "broadcast_cost_vs_single": round(
+                t_bcast / max(t_single, 1e-9), 2
+            ),
+            "naive_cost_vs_single": round(t_naive / max(t_single, 1e-9), 2),
+            "encrypted_wire_bytes_v2_str": len(wire_v2_str),
+            "encrypted_wire_bytes_v1_str": legacy_wire_len,
+            "encrypted_wire_reduction": round(
+                1.0 - len(wire_v2_str) / legacy_wire_len, 4
+            ),
+            "cross_format_compat": compat,
+            "broadcast_within_2x": bool(
+                t_bcast / max(t_single, 1e-9) <= 2.0
+            ),
+        }
+        parity_all = parity_all and compat
+
+    checks = {
+        "on_wire_reduction_ge_25pct": bool(
+            big["on_wire_reduction"] >= 0.25
+        ),
+        "throughput_ge_3x": bool(big["roundtrip_speedup_v2_vs_v1"] >= 3.0),
+        "parity": bool(parity_all and df_ok),
+        "broadcast_within_2x": crypto.get("broadcast_within_2x"),
+    }
+    print(json.dumps({
+        "sizes": sizes_out,
+        "dataframe_roundtrip_ok": df_ok,
+        "broadcast_encryption": crypto,
+        "checks": checks,
+    }))
+
+
 def worker_baseline() -> None:
     """Reference-shaped rounds: sequential stations + JSON payload hops.
 
@@ -1155,6 +1351,22 @@ def main() -> None:
     legs_done.append(leg_marker("host_parallel", hp, hp_diag))
     emit()
 
+    # ---- wire format v1 vs v2 (binary payload path PR) ----------------
+    # CPU by design: (de)serialization + AES are host-side costs; keeps the
+    # leg off a possibly wedged TPU tunnel entirely.
+    wf, wf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        wf, wf_diag = _run_worker(
+            "wireformat", force_cpu=True,
+            timeout_s=leg_timeout(WIRE_TIMEOUT_S),
+        )
+    if wf is not None:
+        out["wire_format"] = wf
+    else:
+        out["wire_format_error"] = wf_diag
+    legs_done.append(leg_marker("wire_format", wf, wf_diag))
+    emit()
+
     # ---- MXU utilization metric (transformer) -------------------------
     tf, tf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
     if remaining() > MIN_LEG_S:
@@ -1293,6 +1505,7 @@ if __name__ == "__main__":
          "agg": worker_agg,
          "baseline": worker_baseline,
          "hostparallel": worker_hostparallel,
+         "wireformat": worker_wireformat,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()
     else:

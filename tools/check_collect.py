@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Fail fast when pytest collection has ANY errors.
+"""Fail fast when pytest collection has ANY errors — or the wire breaks.
 
 A missing optional dependency once turned 20 test modules into collection
 errors that `--continue-on-collection-errors` quietly rode past — zeroing
@@ -8,20 +8,92 @@ out most of the suite while the run still "completed". This gate runs
 every broken module, so a collection regression can never again hide
 inside a green-looking run.
 
+It ALSO decodes the committed golden wire blobs (tests/data/golden_v1.json
+and golden_v2.bin — one payload, both wire formats) and checks their
+contents against the expected values. On-disk task inputs/results and
+cross-version peers depend on these formats decoding forever; a change to
+`common.serialization` that stops round-tripping either one is a
+wire-compat regression and fails here before any test runs.
+
 Usage:
     python tools/check_collect.py [pytest target, default: tests/]
 
-Exit codes: 0 = clean collection; 1 = collection errors (details printed);
-2 = pytest itself could not run.
+Exit codes: 0 = clean collection + wire compat; 1 = collection errors or a
+golden blob stopped decoding (details printed); 2 = pytest itself could
+not run.
 """
 from __future__ import annotations
 
+import os
 import re
 import subprocess
 import sys
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_golden_blobs() -> list[str]:
+    """Decode tests/data/golden_{v1,v2} and verify the payload contents.
+
+    Returns a list of failure descriptions (empty = wire compat holds).
+    Missing fixture files are failures too: deleting them must not
+    silently disable the gate.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        import numpy as np
+
+        from vantage6_tpu.common.serialization import deserialize
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import serialization layer: {e!r}"]
+
+    expected_weights = np.arange(6, dtype=np.float32).reshape(2, 3) * 0.5
+    for name in ("golden_v1.json", "golden_v2.bin"):
+        path = os.path.join(_REPO_ROOT, "tests", "data", name)
+        try:
+            blob = open(path, "rb").read()
+        except OSError as e:
+            problems.append(f"{name}: fixture unreadable ({e})")
+            continue
+        try:
+            out = deserialize(blob)
+        except Exception as e:
+            problems.append(f"{name}: failed to decode: {e!r}")
+            continue
+        checks = [
+            ("method", out.get("method") == "golden"),
+            ("args", out.get("args") == [1, 2.5, "x", None, True]),
+            ("weights", isinstance(out.get("weights"), np.ndarray)
+             and out["weights"].dtype == np.float32
+             and np.array_equal(out["weights"], expected_weights)),
+            ("scalar_f32", type(out.get("scalar_f32")) is np.float32
+             and out["scalar_f32"] == np.float32(1.5)),
+            ("scalar_i64", type(out.get("scalar_i64")) is np.int64
+             and out["scalar_i64"] == np.int64(3)),
+            ("blob", out.get("blob") == b"\x00\x01\x02v6t"),
+        ]
+        bad = [field for field, ok in checks if not ok]
+        if bad:
+            problems.append(
+                f"{name}: decoded but fields no longer round-trip: {bad}"
+            )
+    return problems
+
 
 def main(argv: list[str]) -> int:
+    # wire-compat gate first: cheapest check, clearest failure
+    wire_problems = check_golden_blobs()
+    if wire_problems:
+        sys.stderr.write(
+            "WIRE COMPAT BROKEN: committed golden blob(s) stopped "
+            "round-tripping (tests/data/, docs/wire_format.md):\n"
+        )
+        for p in wire_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     target = argv[1:] or ["tests/"]
     cmd = [
         sys.executable, "-m", "pytest", *target,
@@ -56,6 +128,7 @@ def main(argv: list[str]) -> int:
     if n_errors == 0 and proc.returncode == 0:
         tests = re.findall(r"^(\d+) tests? collected", out, re.M)
         counted = tests[-1] if tests else "all"
+        print("wire compat ok: golden v1+v2 blobs round-trip")
         print(f"collection clean: {counted} tests collected")
         return 0
     if n_errors == 0:

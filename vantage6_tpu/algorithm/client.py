@@ -62,6 +62,14 @@ class AlgorithmClient:
             task_id, timeout=timeout, interval=interval
         )
 
+    def task_timing(self, task_id: int) -> dict[str, Any]:
+        """Per-run lifecycle + straggler decomposition + per-round wire
+        accounting (bytes out/in, encode/decode seconds, broadcast dedup
+        hits) for one of this algorithm's (sub)tasks — see
+        ``Federation.task_timing``. Central code uses this to adapt to
+        stations that are transfer-bound rather than compute-bound."""
+        return self._fed.task_timing(task_id)
+
     def wait_for_stacked_result(self, task_id: int) -> tuple[Any, Any]:
         """TPU fast path (no reference equivalent): returns ``(stacked,
         mask)`` — the on-device [S, ...] result pytree over the FULL station

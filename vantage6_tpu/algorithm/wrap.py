@@ -11,6 +11,12 @@ mount): ``INPUT_FILE``, ``OUTPUT_FILE``, ``TOKEN_FILE``,
 ``USER_REQUESTED_DATABASE_LABELS`` (comma-separated) and per label
 ``DATABASE_<LABEL>_URI`` / ``DATABASE_<LABEL>_TYPE``.
 
+INPUT_FILE/OUTPUT_FILE payloads ride the wire format of
+``common.serialization``: reads auto-detect v1 JSON vs the v2 binary frame,
+writes follow ``V6T_WIRE_FORMAT`` (the node's TaskRunner forwards its
+``wire_format`` policy through this env var, so both sides of the ABI agree
+— docs/wire_format.md).
+
 On-pod execution does NOT go through this file — the Federation binds an
 `AlgorithmEnvironment` directly (no serialization boundary in the hot loop).
 This entrypoint exists so an algorithm written for this framework can still
@@ -52,7 +58,9 @@ def wrap_algorithm(module: ModuleType | str | None = None) -> None:
     input_path = _require_env("INPUT_FILE")
     output_path = _require_env("OUTPUT_FILE")
     with open(input_path, "rb") as f:
-        payload = deserialize(f.read())
+        # writable: algorithm code may mutate its input arrays in place
+        # (v1 np.load semantics — the v2 zero-copy view is read-only)
+        payload = deserialize(f.read(), writable=True)
     method = payload.get("method")
     if not method:
         raise ValueError("input payload needs a 'method'")

@@ -173,7 +173,10 @@ class UserClient:
             if not blob:
                 out.append(None)
                 continue
-            out.append(deserialize(self.cryptor.decrypt_str_to_bytes(blob)))
+            # writable: researchers get arrays they can mutate (v1 parity)
+            out.append(deserialize(
+                self.cryptor.decrypt_str_to_bytes(blob), writable=True
+            ))
         return out
 
 
@@ -233,7 +236,6 @@ class TaskSubClient(SubClient):
         ``device_engine`` so their daemons joined the mesh at start)."""
         input_ = input_ or {}
         blob = serialize(input_)
-        org_specs = []
         # the COLLABORATION decides whether payloads are encrypted (the
         # reference refuses mismatches at submit time, not at the node)
         collab = self.parent.collaboration.get(collaboration)
@@ -247,6 +249,7 @@ class TaskSubClient(SubClient):
         # an unencrypted collaboration always rides plain base64, even when
         # the researcher holds a key (nodes there have no cryptor)
         cryptor = self.parent.cryptor if encrypting else DummyCryptor()
+        pubkeys = []
         for org_id in organizations:
             if encrypting:
                 org = self.parent.organization.get(org_id)
@@ -259,9 +262,15 @@ class TaskSubClient(SubClient):
                     )
             else:
                 pubkey = ""
-            org_specs.append(
-                {"id": org_id, "input": cryptor.encrypt_bytes_to_str(blob, pubkey)}
-            )
+            pubkeys.append(pubkey)
+        # single-pass broadcast encryption: one AES pass over the payload +
+        # one RSA key seal per organization (encrypt_bytes_broadcast), not
+        # one full encrypt per destination
+        wires = cryptor.encrypt_bytes_to_str_broadcast(blob, pubkeys)
+        org_specs = [
+            {"id": org_id, "input": wire}
+            for org_id, wire in zip(organizations, wires)
+        ]
         body = {
             "name": name,
             "description": description,

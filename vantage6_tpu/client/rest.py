@@ -67,7 +67,11 @@ class RestAlgorithmClient:
             blob = run.get("result")
             # the proxy has already decrypted: blob is base64 of the
             # serialized payload
-            out.append(deserialize(_unb64(blob)) if blob else None)
+            # writable: results land in algorithm code (may mutate, v1
+            # semantics — the v2 zero-copy view is read-only)
+            out.append(
+                deserialize(_unb64(blob), writable=True) if blob else None
+            )
         return out
 
 

@@ -750,7 +750,8 @@ class NodeDaemon:
                     raise
         try:
             payload = deserialize(
-                self.cryptor.decrypt_str_to_bytes(run["input"] or "")
+                self.cryptor.decrypt_str_to_bytes(run["input"] or ""),
+                writable=True,  # args flow into algorithm code (may mutate)
             )
         except Exception:
             patch(
@@ -870,7 +871,15 @@ class NodeDaemon:
             if self.encrypted and init_org is not None:
                 org = self.request("GET", f"organization/{init_org}")
                 pubkey = org.get("public_key") or ""
-            blob = self.cryptor.encrypt_bytes_to_str(serialize(result), pubkey)
+            # the node's wire_format policy covers the UPLOADED result too
+            # (not just the container ABI): a node pinned to v1 for old
+            # researcher clients must not push v2 binary result blobs
+            wire_format = self.runner.policies.get("wire_format")
+            blob = self.cryptor.encrypt_bytes_to_str(
+                serialize(result, format=wire_format),
+                pubkey,
+                format=wire_format,
+            )
             patch(
                 status=TaskStatus.COMPLETED.value,
                 result=blob,

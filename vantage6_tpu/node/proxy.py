@@ -76,11 +76,14 @@ class NodeProxy:
         return self._org_pubkeys[org_id]
 
     def _decrypt_result(self, blob: str | None) -> str | None:
-        """Encrypted-toward-our-org blob -> base64(plaintext serialized)."""
+        """Encrypted-toward-our-org blob -> base64(plaintext serialized).
+
+        ``decrypt_bytes`` auto-detects the wire framing, so v1 '$'-joined
+        strings and base64'd v2 binary frames both decrypt."""
         if not blob:
             return blob
         try:
-            plain = self.cryptor.decrypt_str_to_bytes(blob)
+            plain = self.cryptor.decrypt_bytes(blob)
         except Exception:
             # result was encrypted toward a different org (not our task
             # tree) — pass the ciphertext through rather than failing
@@ -101,18 +104,31 @@ class NodeProxy:
                 input_plain = base64.b64decode(body.get("input", ""))
             except Exception:
                 raise HTTPError(400, "input must be base64") from None
-            org_specs = []
-            for org_id in orgs:
-                wire = self.cryptor.encrypt_bytes_to_str(
-                    input_plain,
-                    self._pubkey(req, int(org_id)) if self.encrypted else "",
-                )
-                org_specs.append({"id": int(org_id), "input": wire})
-            import json as _json
-
+            # single-pass broadcast: the payload is AES-encrypted ONCE and
+            # only the key seal differs per destination organization — an
+            # N-org subtask fan-out no longer pays N full encrypt passes
+            pubkeys = [
+                self._pubkey(req, int(o)) if self.encrypted else ""
+                for o in orgs
+            ]
+            wires = self.cryptor.encrypt_bytes_to_str_broadcast(
+                input_plain, pubkeys
+            )
+            org_specs = [
+                {"id": int(o), "input": w} for o, w in zip(orgs, wires)
+            ]
             method = ""
             try:
-                method = _json.loads(input_plain).get("method", "")
+                # wire-format-aware metadata peek: reads the structure
+                # header only, never materializes the (possibly many-MB)
+                # array buffers just to learn one string
+                from vantage6_tpu.common.serialization import peek_structure
+
+                decoded = peek_structure(input_plain)
+                if isinstance(decoded, dict):
+                    m = decoded.get("method", "")
+                    if isinstance(m, str):
+                        method = m
             except Exception:
                 pass
             upstream = {

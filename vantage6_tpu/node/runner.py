@@ -111,6 +111,13 @@ class TaskRunner:
         if mode not in ("sandbox", "inline"):
             raise ValueError(f"unknown runner mode {mode!r}")
         self.mode = mode
+        # a typo'd wire_format policy must fail NODE STARTUP, not turn
+        # every later run into a CRASHED serialize() error
+        wire_format = self.policies.get("wire_format")
+        if wire_format is not None:
+            from vantage6_tpu.common.serialization import normalize_format
+
+            self.policies["wire_format"] = normalize_format(str(wire_format))
         # device_engine: this node's daemon owns (a slice of) the federation
         # device mesh — it joined jax.distributed at start — and accepts
         # engine="device" tasks. Off by default: a device task arriving at an
@@ -369,7 +376,12 @@ class TaskRunner:
         input_file = run_dir / "input"
         output_file = run_dir / "output"
         token_file = run_dir / "token"
-        input_file.write_bytes(serialize(spec.input_payload))
+        # INPUT_FILE rides the v2 binary wire by default (raw aligned array
+        # buffers, no base64 — docs/wire_format.md); node policy
+        # `wire_format: v1` pins the legacy JSON ABI for old algorithm
+        # containers. wrap_algorithm auto-detects on read either way.
+        wire_format = self.policies.get("wire_format")
+        input_file.write_bytes(serialize(spec.input_payload, format=wire_format))
         token_file.write_text(spec.token)
 
         # the child must be able to import vantage6_tpu regardless of the
@@ -390,6 +402,9 @@ class TaskRunner:
             "RUN_ID": str(spec.run_id),
             "TEMPORARY_FOLDER": str(run_dir),
         }
+        if wire_format:
+            # the child's OUTPUT_FILE serialize follows the same node policy
+            env["V6T_WIRE_FORMAT"] = str(wire_format)
         if not self.policies.get("accelerator", False):
             # sandboxed algorithms default to CPU, like the reference's
             # containers: faster startup and no contention for (or hangs on)
@@ -454,7 +469,9 @@ class TaskRunner:
             )
         if not output_file.exists():
             raise RuntimeError("algorithm wrote no OUTPUT_FILE")
-        return deserialize(output_file.read_bytes())
+        # writable: harvested results are handed onward to caller code
+        # that may mutate them (v1 semantics)
+        return deserialize(output_file.read_bytes(), writable=True)
 
     # ----------------------------------------------------------------- util
     def _db_config(

@@ -319,11 +319,18 @@ class Federation:
                 "ready": False,
                 "columns": [],
             }
+        # on-wire input size (estimated v2 frame bytes, metadata-only walk —
+        # no device transfer, no actual encode): one measurement shared by
+        # every run, the same way a v2 broadcast shares one ciphertext
+        from vantage6_tpu.common.serialization import wire_nbytes
+
+        task.input_wire_bytes = wire_nbytes(input_)
         task.runs = [
             new_run(
                 task_id=task.id,
                 organization=self.stations[o].organization,
                 station_index=o,
+                input_wire_bytes=task.input_wire_bytes,
             )
             for o in orgs
         ]
@@ -370,6 +377,17 @@ class Federation:
         the failure without draining siblings first).
         """
         if self._executor is None:
+            # close() drops queued-but-unstarted work without clearing
+            # _inflight_runs (the pool items never run their finally): say
+            # so, instead of letting wait_for_results misread the stranded
+            # PENDING runs as "offline stations"
+            stranded = self._runs_in_flight(runs)
+            if stranded:
+                raise RuntimeError(
+                    "federation closed while runs "
+                    f"{[r.id for r in stranded]} were queued — their "
+                    "queued work was dropped"
+                )
             return
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -617,6 +635,11 @@ class Federation:
                 result = fn(*args, **kwargs)
             if task.store_as:
                 result = self._store_session_result(task, run, result)
+            # size the result BEFORE finish (post-kill the record is
+            # immutable); metadata-only walk, None when not wire-shaped
+            from vantage6_tpu.common.serialization import wire_nbytes
+
+            run.result_wire_bytes = wire_nbytes(result)
             if run.finish(result):
                 if task.store_as:
                     self._refresh_session_ready(task)
@@ -765,10 +788,15 @@ class Federation:
     def task_timing(self, task_id: int) -> dict[str, Any]:
         """Per-run queued→started→finished lifecycle plus the max-vs-sum
         round-time decomposition (straggler view): a parallel round costs
-        max-over-stations, a sequential one sum-over-stations."""
+        max-over-stations, a sequential one sum-over-stations. ``wire``
+        adds the per-round payload accounting (bytes out/in over this
+        task's runs + the process-wide encode/decode/broadcast counters),
+        so transfer-bound stations are distinguishable from compute-bound
+        ones."""
         from vantage6_tpu.runtime.metrics import (
             round_decomposition,
             run_lifecycle,
+            wire_totals,
         )
 
         task = self.tasks[task_id]
@@ -776,6 +804,7 @@ class Federation:
             "task_id": task_id,
             "runs": [run_lifecycle(r) for r in task.runs],
             **round_decomposition(task.runs),
+            "wire": wire_totals(task.runs),
         }
 
     # -------------------------------------------------------------- teardown

@@ -67,6 +67,14 @@ def run_lifecycle(run: Any) -> dict[str, Any]:
         out["queue_wait_s"] = max(0.0, run.started_at - queued)
         if run.finished_at is not None:
             out["exec_s"] = run.finished_at - run.started_at
+    # on-wire payload sizes (estimated v2 frame bytes, see
+    # serialization.wire_nbytes) — present when the federation measured
+    # them; the straggler view uses these to tell a station that computes
+    # slowly from one that moves big payloads
+    if getattr(run, "input_wire_bytes", None) is not None:
+        out["input_wire_bytes"] = run.input_wire_bytes
+    if getattr(run, "result_wire_bytes", None) is not None:
+        out["result_wire_bytes"] = run.result_wire_bytes
     return out
 
 
@@ -98,6 +106,33 @@ def round_decomposition(runs: list[Any]) -> dict[str, Any]:
         "straggler_station": straggler,
         "parallel_speedup_bound": sum_s / max_s if max_s > 0 else None,
     }
+
+
+def wire_totals(runs: list[Any]) -> dict[str, Any]:
+    """Per-round wire accounting over a task's runs: bytes broadcast out
+    (input, counted once per station — every station receives the payload
+    even though a v2 broadcast encrypts it once) and bytes collected in
+    (results), plus the process-wide encode/decode-seconds and
+    broadcast-dedup counters from `serialization.WIRE_STATS` (snapshot —
+    diff two snapshots to scope them to one round)."""
+    ins = [r.input_wire_bytes for r in runs
+           if getattr(r, "input_wire_bytes", None) is not None]
+    outs = [r.result_wire_bytes for r in runs
+            if getattr(r, "result_wire_bytes", None) is not None]
+    return {
+        "wire_bytes_out": sum(ins) if ins else None,
+        "wire_bytes_in": sum(outs) if outs else None,
+        "n_runs_sized": len(outs),
+        "wire_stats": wire_stats_snapshot(),
+    }
+
+
+def wire_stats_snapshot() -> dict[str, Any]:
+    """Process-wide serialize/deserialize/broadcast counters (bytes,
+    seconds, dedup hits) — one import point for observability consumers."""
+    from vantage6_tpu.common.serialization import WIRE_STATS
+
+    return WIRE_STATS.snapshot()
 
 
 def device_peak_bytes(device: Any = None) -> int | None:

@@ -47,6 +47,13 @@ class Run:
     queued_at: float | None = None
     started_at: float | None = None
     finished_at: float | None = None
+    # on-wire payload accounting (common.serialization.wire_nbytes): what
+    # this run's input/result WOULD cost on the v2 binary wire — lets the
+    # straggler view tell compute-bound from transfer-bound stations even
+    # in the in-process host path, which never actually serializes. None =
+    # not measured or not wire-serializable.
+    input_wire_bytes: int | None = None
+    result_wire_bytes: int | None = None
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -115,6 +122,9 @@ class Task:
     # dataframe is persisted under at each station
     session_id: int | None = None
     store_as: str | None = None
+    # estimated v2 on-wire size of input_ (shared by every run — a
+    # broadcast sends ONE ciphertext; see encrypt_bytes_broadcast)
+    input_wire_bytes: int | None = None
     runs: list[Run] = dataclasses.field(default_factory=list)
     created_at: float = dataclasses.field(default_factory=time.time)
     # Device-mode only: the stacked [S, ...] on-device result pytree (full
