@@ -103,6 +103,17 @@ AGG_TIMEOUT_S = 600
 # rounds/sec at HOST_STATIONS stations with a sleep-padded partial — pure
 # scheduling comparison, seconds of wall-clock, CPU only.
 HOST_TIMEOUT_S = 240
+# control_plane leg (control-plane fast path PR): one in-process server +
+# CP_DAEMONS real node daemons over HTTP, CP_TASKS small partial tasks
+# submitted back to back, measured twice — per-run endpoints + fixed-
+# interval polling (the pre-PR shape) vs batched claim/report + long-poll
+# event wakeups. Reports submit→result-visible p50/p95, run dispatch
+# (assigned→started) p50/p95, tasks/sec, REST calls/task, and a
+# cross-arm results-parity flag. Host CPU only by design.
+CONTROL_TIMEOUT_S = 420
+CP_DAEMONS = 8
+CP_TASKS = 40
+CP_WIDTH = 2          # organizations targeted per task
 # wire_format leg (binary wire PR): v1 JSON+base64 vs v2 framed-binary
 # (de)serialization throughput + on-wire bytes on model-weight pytrees and a
 # DataFrame stats table, plus single-pass broadcast encryption cost when the
@@ -810,6 +821,169 @@ def worker_hostparallel() -> None:
     }))
 
 
+def worker_controlplane() -> None:
+    """control_plane leg: batched+event-driven vs per-run+polled dispatch.
+
+    The SAME server build serves both arms (old endpoints stay live —
+    mixed-version is an acceptance criterion); only the DAEMON/CLIENT
+    transport policy differs: the legacy arm pins `transport="per-run"`,
+    `event_wait=0` and a fixed 0.25 s client poll (the pre-PR shape), the
+    fast arm uses the batched claim/report endpoints and long-poll event
+    wakeups. Tasks are tiny pandas partials so the measured time IS
+    control-plane latency, not compute. Parity asserts per arm (every
+    task completed, exactly one run per targeted org) and across arms
+    (identical results for identical inputs — no lost/duplicated runs).
+    """
+    _worker_setup()
+    import statistics
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from vantage6_tpu.client import UserClient
+    from vantage6_tpu.common.enums import TaskStatus
+    from vantage6_tpu.common.rest import REST_STATS
+    from vantage6_tpu.node.daemon import NodeDaemon
+    from vantage6_tpu.server.app import ServerApp
+
+    n_daemons = int(os.environ.get("BENCH_CP_DAEMONS", str(CP_DAEMONS)))
+    n_tasks = int(os.environ.get("BENCH_CP_TASKS", str(CP_TASKS)))
+    image, module = "v6-average-py", "vantage6_tpu.workloads.average"
+
+    tmp = tempfile.mkdtemp(prefix="v6t-cp-bench-")
+    rng = np.random.default_rng(7)
+    csvs = []
+    for i in range(n_daemons):
+        path = os.path.join(tmp, f"s{i:02d}.csv")
+        pd.DataFrame(
+            {"age": rng.uniform(20, 80, 32).round(1)}
+        ).to_csv(path, index=False)
+        csvs.append(path)
+
+    def arm(fast: bool) -> dict:
+        srv = ServerApp()
+        srv.ensure_root(password="rootpass123")
+        http = srv.serve(port=0, background=True)
+        client = UserClient(http.url)
+        if not fast:
+            client._event_push = False  # pin the fixed-interval poll
+        client.authenticate("root", "rootpass123")
+        orgs, daemons = [], []
+        for i in range(n_daemons):
+            org = client.organization.create(name=f"cp{i:02d}")
+            orgs.append(org)
+        collab = client.collaboration.create(
+            name="cp", organization_ids=[o["id"] for o in orgs]
+        )
+        for i, org in enumerate(orgs):
+            ni = client.node.create(
+                organization_id=org["id"], collaboration_id=collab["id"]
+            )
+            d = NodeDaemon(
+                api_url=http.url,
+                api_key=ni["api_key"],
+                algorithms={image: module},
+                databases=[
+                    {"label": "default", "type": "csv", "uri": csvs[i]}
+                ],
+                mode="inline",
+                poll_interval=0.25,
+                transport="batched" if fast else "per-run",
+                event_wait=2.0 if fast else 0.0,
+            )
+            d.start()
+            daemons.append(d)
+        org_ids = [o["id"] for o in orgs]
+        stats0 = REST_STATS.snapshot()
+        latencies, dispatch, results, parity = [], [], [], True
+        t_all0 = time.perf_counter()
+        for i in range(n_tasks):
+            targets = [org_ids[(i + k) % n_daemons] for k in range(CP_WIDTH)]
+            t0 = time.perf_counter()
+            t = client.task.create(
+                collaboration=collab["id"],
+                organizations=targets,
+                image=image,
+                input_={"method": "partial_average",
+                        "kwargs": {"column": "age"}},
+            )
+            res = client.wait_for_results(
+                t["id"], interval=0.25, timeout=120.0
+            )
+            latencies.append(time.perf_counter() - t0)
+            results.append(res)
+            runs = client.run.from_task(t["id"])
+            run_orgs = [r["organization"]["id"] for r in runs]
+            parity &= sorted(run_orgs) == sorted(targets)
+            parity &= all(
+                TaskStatus(r["status"]) == TaskStatus.COMPLETED for r in runs
+            )
+            for r in runs:
+                if r["started_at"] and r["assigned_at"]:
+                    dispatch.append(r["started_at"] - r["assigned_at"])
+        total_s = time.perf_counter() - t_all0
+        stats1 = REST_STATS.snapshot()
+        for d in daemons:
+            d.stop()
+        http.stop()
+        srv.close()
+        lat = sorted(latencies)
+        dsp = sorted(dispatch)
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
+
+        return {
+            "task_p50_s": round(statistics.median(lat), 4),
+            "task_p95_s": round(pct(lat, 95), 4),
+            "dispatch_p50_s": round(statistics.median(dsp), 4),
+            "dispatch_p95_s": round(pct(dsp, 95), 4),
+            "tasks_per_sec": round(n_tasks / total_s, 3),
+            "rest_calls": int(stats1["calls"] - stats0["calls"]),
+            "rest_calls_per_task": round(
+                (stats1["calls"] - stats0["calls"]) / n_tasks, 1
+            ),
+            "rest_bytes": int(
+                stats1["bytes_sent"] + stats1["bytes_received"]
+                - stats0["bytes_sent"] - stats0["bytes_received"]
+            ),
+            "stale_retries": int(
+                stats1["stale_retries"] - stats0["stale_retries"]
+            ),
+            "parity_ok": bool(parity),
+            "results": results,
+        }
+
+    legacy = arm(fast=False)
+    fast = arm(fast=True)
+    cross_parity = legacy.pop("results") == fast.pop("results")
+    print(json.dumps({
+        "n_daemons": n_daemons,
+        "n_tasks": n_tasks,
+        "width": CP_WIDTH,
+        "per_run_polled": legacy,
+        "batched_pushed": fast,
+        "speedup_task_p95": round(
+            legacy["task_p95_s"] / fast["task_p95_s"], 2
+        ) if fast["task_p95_s"] > 0 else None,
+        "speedup_dispatch_p95": round(
+            legacy["dispatch_p95_s"] / fast["dispatch_p95_s"], 2
+        ) if fast["dispatch_p95_s"] > 0 else None,
+        "speedup_tasks_per_sec": round(
+            fast["tasks_per_sec"] / legacy["tasks_per_sec"], 2
+        ),
+        "rest_calls_reduction": round(
+            legacy["rest_calls"] / fast["rest_calls"], 2
+        ) if fast["rest_calls"] else None,
+        # no lost/duplicated runs in either arm AND identical results for
+        # identical inputs across arms
+        "results_parity": bool(
+            legacy["parity_ok"] and fast["parity_ok"] and cross_parity
+        ),
+    }))
+
+
 def worker_wireformat() -> None:
     """wire_format leg: v1 (JSON + base64 .npy) vs v2 (framed binary) wire.
 
@@ -1351,6 +1525,22 @@ def main() -> None:
     legs_done.append(leg_marker("host_parallel", hp, hp_diag))
     emit()
 
+    # ---- control-plane fast path (batched + event-driven dispatch) ----
+    # CPU by design: pure scheduler/transport latency, no tensor compute;
+    # force_cpu also keeps the leg off a possibly wedged tunnel entirely.
+    cp, cp_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        cp, cp_diag = _run_worker(
+            "controlplane", force_cpu=True,
+            timeout_s=leg_timeout(CONTROL_TIMEOUT_S),
+        )
+    if cp is not None:
+        out["control_plane"] = cp
+    else:
+        out["control_plane_error"] = cp_diag
+    legs_done.append(leg_marker("control_plane", cp, cp_diag))
+    emit()
+
     # ---- wire format v1 vs v2 (binary payload path PR) ----------------
     # CPU by design: (de)serialization + AES are host-side costs; keeps the
     # leg off a possibly wedged TPU tunnel entirely.
@@ -1505,6 +1695,7 @@ if __name__ == "__main__":
          "agg": worker_agg,
          "baseline": worker_baseline,
          "hostparallel": worker_hostparallel,
+         "controlplane": worker_controlplane,
          "wireformat": worker_wireformat,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()

@@ -91,6 +91,11 @@ def stack(tmp_path_factory):
             ],
             mode="inline",
             poll_interval=1.0 if i == N - 1 else 0.05,
+            # the LAST station must genuinely be slow to see its tasks
+            # (the dropout test kills it inside that window) — pin it to
+            # legacy fixed-interval polling; long-poll wakeups would make
+            # it react in milliseconds and void the test's premise
+            event_wait=0.0 if i == N - 1 else 2.0,
             station_secret=pysecrets.token_hex(32),
         )
         d.start()

@@ -15,12 +15,19 @@ cross-version peers depend on these formats decoding forever; a change to
 `common.serialization` that stops round-tripping either one is a
 wire-compat regression and fails here before any test runs.
 
+It ALSO audits the control-plane fast-path ROUTES: the batched endpoints
+and long-poll event channel (`run/claim-batch`, `run/batch`, `event`) must
+exist in `server/resources.py`'s route table AND still be referenced by the
+daemon/client call sites that depend on them. A rename on either side
+silently degrades every "new" daemon to the per-run fallback forever — this
+gate turns that silent drift into a loud failure before any test runs.
+
 Usage:
     python tools/check_collect.py [pytest target, default: tests/]
 
-Exit codes: 0 = clean collection + wire compat; 1 = collection errors or a
-golden blob stopped decoding (details printed); 2 = pytest itself could
-not run.
+Exit codes: 0 = clean collection + wire compat + route audit; 1 = collection
+errors, a golden blob stopped decoding, or a batched route drifted (details
+printed); 2 = pytest itself could not run.
 """
 from __future__ import annotations
 
@@ -30,6 +37,54 @@ import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# endpoint (as referenced by clients, no /api/ prefix) -> the call-site
+# files that must mention it. Kept literal on purpose: the audit is about
+# agreement between fixed strings on both sides of the wire.
+_ROUTE_AUDIT: dict[str, list[str]] = {
+    "run/claim-batch": ["vantage6_tpu/node/daemon.py"],
+    "run/batch": ["vantage6_tpu/node/daemon.py"],
+    "event": [
+        "vantage6_tpu/node/daemon.py",
+        "vantage6_tpu/common/rest.py",      # await_task_finished long-poll
+        "vantage6_tpu/node/proxy.py",       # event relay for containers
+    ],
+}
+
+
+def check_control_plane_routes() -> list[str]:
+    """Static audit: every batched/long-poll endpoint exists as a server
+    route AND is referenced by its expected call sites. Returns failure
+    descriptions (empty = no drift)."""
+    problems: list[str] = []
+    res_path = os.path.join(
+        _REPO_ROOT, "vantage6_tpu", "server", "resources.py"
+    )
+    try:
+        resources_src = open(res_path).read()
+    except OSError as e:
+        return [f"cannot read {res_path}: {e}"]
+    routes = set(re.findall(r'@app\.route\("([^"]+)"', resources_src))
+    for endpoint, call_sites in _ROUTE_AUDIT.items():
+        if f"/api/{endpoint}" not in routes:
+            problems.append(
+                f"server route /api/{endpoint} is gone from "
+                "server/resources.py but daemons/clients still call it"
+            )
+        for rel in call_sites:
+            path = os.path.join(_REPO_ROOT, rel)
+            try:
+                src = open(path).read()
+            except OSError as e:
+                problems.append(f"{rel}: call-site file unreadable ({e})")
+                continue
+            if f'"{endpoint}"' not in src:
+                problems.append(
+                    f"{rel} no longer references endpoint {endpoint!r} — "
+                    "either the fast path was removed (update this audit) "
+                    "or the call site drifted from the route name"
+                )
+    return problems
 
 
 def check_golden_blobs() -> list[str]:
@@ -94,6 +149,16 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    route_problems = check_control_plane_routes()
+    if route_problems:
+        sys.stderr.write(
+            "CONTROL-PLANE ROUTE DRIFT: batched REST endpoints and their "
+            "call sites disagree (docs/control_plane.md):\n"
+        )
+        for p in route_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     target = argv[1:] or ["tests/"]
     cmd = [
         sys.executable, "-m", "pytest", *target,
@@ -129,6 +194,8 @@ def main(argv: list[str]) -> int:
         tests = re.findall(r"^(\d+) tests? collected", out, re.M)
         counted = tests[-1] if tests else "all"
         print("wire compat ok: golden v1+v2 blobs round-trip")
+        print("route audit ok: batched control-plane endpoints match "
+              "their call sites")
         print(f"collection clean: {counted} tests collected")
         return 0
     if n_errors == 0:

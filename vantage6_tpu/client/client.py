@@ -42,6 +42,9 @@ class UserClient:
         self.whoami: dict[str, Any] | None = None
         self.cryptor: CryptorBase = DummyCryptor()
         self._encryption_configured = False
+        # event long-poll capability (None until probed; see
+        # common.rest.await_task_finished)
+        self._event_push: bool | None = None
         self._rest = RestSession(
             self.base_url,
             token_getter=lambda: self._access_token,
@@ -69,8 +72,11 @@ class UserClient:
         endpoint: str,
         json_body: Any = None,
         params: dict[str, Any] | None = None,
+        timeout: float | None = None,
     ) -> Any:
-        return self._rest.request(method, endpoint, json_body, params)
+        return self._rest.request(
+            method, endpoint, json_body, params, timeout=timeout
+        )
 
     def paginate(
         self, endpoint: str, params: dict[str, Any] | None = None
@@ -147,21 +153,19 @@ class UserClient:
     def wait_for_results(
         self, task_id: int, interval: float = 0.5, timeout: float = 300.0
     ) -> list[Any]:
-        """Poll until the task finishes; return decrypted, deserialized
-        results (reference: UserClient.wait_for_results)."""
-        from vantage6_tpu.common.enums import TaskStatus
+        """Wait until the task finishes; return decrypted, deserialized
+        results (reference: UserClient.wait_for_results).
 
-        deadline = time.time() + timeout
-        while True:
-            task = self.request("GET", f"task/{task_id}")
-            status = TaskStatus(task["status"])
-            if status.is_finished:
-                break
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"task {task_id} still {status.value} after {timeout}s"
-                )
-            time.sleep(interval)
+        Event-driven against a long-poll-capable server: blocks on the
+        event stream and wakes the moment a `status-update` reports the
+        task finished, re-checking the task itself each cycle as the
+        anti-entropy backstop (events can be evicted, and the user's
+        rooms may not cover the task's collaboration). Falls back to
+        fixed-`interval` polling against an older server.
+        """
+        from vantage6_tpu.common.rest import await_task_finished
+
+        status = await_task_finished(self, task_id, interval, timeout)
         if status.has_failed:
             runs = self.paginate(f"task/{task_id}/run")
             logs = {r["organization"]["id"]: r["log"] for r in runs}

@@ -9,7 +9,6 @@ containerized (the reference's central fns run unchanged too).
 """
 from __future__ import annotations
 
-import time
 from typing import Any
 
 from vantage6_tpu.common.rest import RestSession
@@ -24,6 +23,10 @@ class RestAlgorithmClient:
                 token = f.read().strip()
         self.token = token
         self._rest = RestSession(self.base_url, token_getter=lambda: self.token)
+        # event long-poll capability through the node proxy (None until
+        # probed; see common.rest.await_task_finished) — an old proxy
+        # without the /api/event forward demotes this client to polling
+        self._event_push: bool | None = None
         self.task = _TaskSub(self)
         self.result = _ResultSub(self)
         self.run = _RunSub(self)
@@ -36,8 +39,11 @@ class RestAlgorithmClient:
         endpoint: str,
         json_body: Any = None,
         params: dict[str, Any] | None = None,
+        timeout: float | None = None,
     ) -> Any:
-        return self._rest.request(method, endpoint, json_body, params)
+        return self._rest.request(
+            method, endpoint, json_body, params, timeout=timeout
+        )
 
     def paginate(
         self, endpoint: str, params: dict[str, Any] | None = None
@@ -48,17 +54,14 @@ class RestAlgorithmClient:
     def wait_for_results(
         self, task_id: int, interval: float = 1.0, timeout: float = 600.0
     ) -> list[Any]:
-        from vantage6_tpu.common.enums import TaskStatus
+        """Wait for a subtask fan-out — event-driven when the node proxy
+        forwards the server's long-poll event stream (a central algorithm
+        then wakes on its partials' completion events instead of paying up
+        to `interval` of dead time per wave); fixed-interval polling
+        against an older proxy."""
+        from vantage6_tpu.common.rest import await_task_finished
 
-        deadline = time.time() + timeout
-        while True:
-            task = self.request("GET", f"task/{task_id}")
-            status = TaskStatus(task["status"])
-            if status.is_finished:
-                break
-            if time.time() > deadline:
-                raise TimeoutError(f"task {task_id} timed out")
-            time.sleep(interval)
+        status = await_task_finished(self, task_id, interval, timeout)
         if status.has_failed:
             raise RuntimeError(f"subtask {task_id} {status.value}")
         runs = self.paginate(f"task/{task_id}/run")

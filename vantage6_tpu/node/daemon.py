@@ -10,12 +10,13 @@ same payloads — the cursor IS the reconnect path in both designs).
 from __future__ import annotations
 
 import queue
+import random
 import threading
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 from vantage6_tpu.common.encryption import CryptorBase, DummyCryptor, RSACryptor
 from vantage6_tpu.common.rest import RestError, RestSession
@@ -32,6 +33,153 @@ from vantage6_tpu.node.runner import (
 )
 
 log = setup_logging("vantage6_tpu/node")
+
+
+def backoff_delay(
+    base: float,
+    failures: int,
+    cap: float = 10.0,
+    rng: Callable[[], float] = random.random,
+) -> float:
+    """Capped exponential backoff with jitter for the event-poll retry.
+
+    Failure n sleeps uniform(0.5, 1.0) × min(cap, base · 2^(n-1)). The
+    jitter is the point: 32 daemons that all lost the same restarting
+    server must retry DECORRELATED, not hammer it again in lockstep at a
+    fixed multiple of their shared poll_interval.
+    """
+    delay = min(cap, base * (2 ** max(0, failures - 1)))
+    return delay * (0.5 + 0.5 * rng())
+
+
+class _PendingReport:
+    """One queued run PATCH awaiting its batch flush."""
+
+    __slots__ = ("run_id", "fields", "done", "error")
+
+    def __init__(self, run_id: int, fields: dict[str, Any]):
+        self.run_id = run_id
+        self.fields = fields
+        self.done = threading.Event()
+        self.error: Exception | None = None
+
+
+class _BatchReporter:
+    """Coalesces concurrent run status/result PATCHes into one
+    ``PATCH /api/run/batch`` request.
+
+    Worker threads call `submit_and_wait` — synchronous per caller (the
+    ACTIVE-before-barrier and report-before-return orderings are
+    preserved), but the TRANSPORT batches whatever is queued at flush
+    time: when several of the daemon's workers finish near-simultaneously
+    their reports ride one request. A lone report degrades to a batch of
+    one. Per-item server outcomes (409 terminal, 403, ...) are re-raised
+    in the submitting thread as RestError, so every existing caller-side
+    handler (the 409 "already terminal" path) works unchanged. If the
+    server lacks the batch endpoint (404/405: un-upgraded server), the
+    items are replayed as per-run PATCHes and the daemon pins itself to
+    the per-run path.
+    """
+
+    def __init__(self, daemon: "NodeDaemon"):
+        self._daemon = daemon
+        self._q: "queue.Queue[_PendingReport]" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def submit_and_wait(self, run_id: int, fields: dict[str, Any]) -> None:
+        item = _PendingReport(run_id, fields)
+        self._ensure_thread()
+        self._q.put(item)
+        if not item.done.wait(timeout=120.0):
+            raise RestError(504, f"batched report for run {run_id} timed out")
+        if item.error is not None:
+            raise item.error
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="v6t-report"
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            self._flush(self._drain(first))
+        # stop requested: flush whatever is still queued so a final
+        # COMPLETED report is never abandoned mid-shutdown
+        try:
+            while True:
+                self._flush(self._drain(self._q.get_nowait()))
+        except queue.Empty:
+            pass
+
+    def _drain(self, first: _PendingReport) -> list[_PendingReport]:
+        batch = [first]
+        while len(batch) < 250:
+            try:
+                batch.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return batch
+
+    def _flush(self, batch: list[_PendingReport]) -> None:
+        d = self._daemon
+        if d._batch_ok is False:
+            for item in batch:
+                self._flush_single(item)
+            return
+        try:
+            resp = d.request(
+                "PATCH",
+                "run/batch",
+                {"runs": [{"id": it.run_id, **it.fields} for it in batch]},
+            )
+        except RestError as e:
+            if e.status in (404, 405):
+                d._batch_ok = False  # un-upgraded server: per-run forever
+                for item in batch:
+                    self._flush_single(item)
+                return
+            self._finish_all(batch, e)
+            return
+        except Exception as e:
+            self._finish_all(batch, e)
+            return
+        by_id = {r.get("id"): r for r in resp.get("data", [])}
+        for item in batch:
+            r = by_id.get(item.run_id)
+            if r is None:
+                item.error = RestError(
+                    500, f"batch response missing run {item.run_id}"
+                )
+            elif r.get("status_code", 200) >= 400:
+                item.error = RestError(r["status_code"], r.get("msg", ""))
+            item.done.set()
+
+    def _flush_single(self, item: _PendingReport) -> None:
+        try:
+            self._daemon.request("PATCH", f"run/{item.run_id}", item.fields)
+        except Exception as e:
+            item.error = e
+        item.done.set()
+
+    @staticmethod
+    def _finish_all(batch: list[_PendingReport], err: Exception) -> None:
+        for item in batch:
+            item.error = err
+            item.done.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
 
 
 class NodeDaemon:
@@ -51,7 +199,23 @@ class NodeDaemon:
         station_secret: str | bytes | None = None,
         vpn: dict[str, Any] | None = None,
         device_engine: dict[str, Any] | None = None,
+        transport: str = "batched",
+        event_wait: float = 2.0,
     ):
+        # control-plane transport policy:
+        # - transport="batched" (default): claim sweeps, per-run dispatch
+        #   fetches and status reports ride the multi-run endpoints
+        #   (POST /run/claim-batch, PATCH /run/batch), falling back to the
+        #   per-run endpoints automatically against an un-upgraded server;
+        #   "per-run" pins the legacy per-run path (mixed-version testing).
+        # - event_wait>0: event polls long-poll (?wait=S) so a dispatched
+        #   run wakes this daemon on event PROPAGATION, with the
+        #   poll_interval sweep demoted to the anti-entropy fallback;
+        #   0 pins the legacy fixed-interval polling.
+        if transport not in ("batched", "per-run"):
+            raise ValueError(
+                f"transport must be 'batched' or 'per-run', got {transport!r}"
+            )
         # Device-engine membership FIRST: jax.distributed must be joined
         # before anything initializes the jax backend. With a coordinator
         # configured this daemon becomes one process of the federation's
@@ -75,6 +239,20 @@ class NodeDaemon:
         self.api_key = api_key
         self.poll_interval = poll_interval
         self.sync_interval = sync_interval
+        self.transport = transport
+        self.event_wait = max(0.0, float(event_wait))
+        # None = capability unknown; False = server lacks the batch
+        # endpoints / long-poll (detected once, then pinned)
+        self._batch_ok: bool | None = (
+            None if transport == "batched" else False
+        )
+        self._long_poll: bool | None = None
+        self._poll_failures = 0
+        self._reporter = _BatchReporter(self)
+        # run_id -> claim-batch entry (run dict + embedded task +
+        # container token): what a batched claim prefetched so _execute
+        # skips its per-run GET run / GET task / POST token round-trips
+        self._prefetched: dict[int, dict[str, Any]] = {}
         self._access_token: str | None = None
         self._refresh_token: str | None = None
         self._rest = RestSession(
@@ -177,6 +355,8 @@ class NodeDaemon:
             station_secret=cfg.get("station_secret") or None,
             vpn=cfg.get("vpn") or None,
             device_engine=cfg.get("device_engine"),
+            transport=cfg.get("transport", "batched"),
+            event_wait=cfg.get("event_wait", 2.0),
             **overrides,
         )
 
@@ -191,8 +371,50 @@ class NodeDaemon:
         endpoint: str,
         json_body: Any = None,
         params: dict[str, Any] | None = None,
+        timeout: float | None = None,
     ) -> Any:
-        return self._rest.request(method, endpoint, json_body, params)
+        return self._rest.request(
+            method, endpoint, json_body, params, timeout=timeout
+        )
+
+    # --------------------------------------------------- batched transport
+    def _claim_batch(
+        self,
+        run_ids: list[int] | None = None,
+        reset_orphans: bool = False,
+        max_runs: int = 250,
+    ) -> list[dict[str, Any]] | None:
+        """One ``POST /api/run/claim-batch``; None when the server lacks
+        the endpoint (the daemon pins itself to the per-run path)."""
+        if self._batch_ok is False:
+            return None
+        body: dict[str, Any] = {"max": max_runs}
+        if run_ids is not None:
+            # explicit dispatch: the caller already claimed these ids
+            body["run_ids"] = run_ids
+        else:
+            with self._claim_lock:
+                body["exclude_run_ids"] = sorted(self._claimed)
+        if reset_orphans:
+            body["reset_orphans"] = True
+        try:
+            resp = self.request("POST", "run/claim-batch", body)
+        except RestError as e:
+            if e.status in (404, 405):
+                log.info("server lacks claim-batch; using per-run dispatch")
+                self._batch_ok = False
+                return None
+            raise
+        self._batch_ok = True
+        return resp.get("data", [])
+
+    def _report(self, run_id: int, **fields: Any) -> None:
+        """Report run status/result — batched (coalescing reporter) when
+        the server supports it, per-run PATCH otherwise."""
+        if self.transport == "batched" and self._batch_ok is not False:
+            self._reporter.submit_and_wait(run_id, fields)
+        else:
+            self.request("PATCH", f"run/{run_id}", fields)
 
     def _iter_pages(self, endpoint: str, params: dict[str, Any] | None = None):
         """Yield every item of a paginated listing (full page drain, 250 a
@@ -294,6 +516,9 @@ class NodeDaemon:
         if self._sync_thread:
             self._sync_thread.join(timeout=10)
         self._pool.shutdown(wait=True, cancel_futures=True)
+        # after the pool: workers inside submit_and_wait need the reporter
+        # alive until their final report flushed
+        self._reporter.stop()
         try:
             self.request("PATCH", f"node/{self.id}", {"status": "offline"})
         except Exception:
@@ -320,9 +545,13 @@ class NodeDaemon:
                 if self._stop.is_set():
                     return
                 discover_at = 0.0  # re-discover after a drop
-            # polling sweep: fallback transport and post-drop catch-up
-            self._poll_once()
-            self._stop.wait(self.poll_interval)
+            # event fetch: long-poll when the server supports it (the
+            # request itself blocks until an event lands, so no sleep);
+            # the fixed poll_interval survives only as the legacy-server
+            # cadence and the post-failure pacing
+            waited = self._poll_once()
+            if not waited:
+                self._stop.wait(self.poll_interval)
 
     def _discover_ws(self) -> str | None:
         try:
@@ -330,40 +559,87 @@ class NodeDaemon:
         except Exception:
             return None
 
-    def _poll_once(self) -> None:
+    def _poll_once(self) -> bool:
+        """One event fetch. Returns True when no further sleep is needed
+        (the server long-polled for us, or the failure path already slept
+        its backoff)."""
+        # name filter: _handle only acts on these three, and without the
+        # filter every status-update in the collaboration room would wake
+        # every long-polling daemon (N× request amplification per event)
+        params: dict[str, Any] = {
+            "since": self._cursor,
+            "names": "task-created,kill-task,session-deleted",
+        }
+        use_wait = self.event_wait > 0 and self._long_poll is not False
+        if use_wait:
+            params["wait"] = self.event_wait
         try:
-            batch = self.request("GET", "event", params={"since": self._cursor})
+            batch = self.request(
+                "GET", "event", params=params,
+                # a long poll must not hang forever on a dead server: give
+                # the server its window plus generous transit margin
+                timeout=(self.event_wait + 30.0) if use_wait else None,
+            )
         except Exception as e:
-            log.warning("event poll failed: %s", e)
-            self._stop.wait(self.poll_interval * 4)
-            return
+            # capped exponential backoff + jitter: N daemons that lost the
+            # same restarting server must NOT retry in lockstep
+            self._poll_failures += 1
+            delay = backoff_delay(
+                max(self.poll_interval, 0.05), self._poll_failures
+            )
+            log.warning(
+                "event poll failed (attempt %d, retry in %.2fs): %s",
+                self._poll_failures, delay, e,
+            )
+            self._stop.wait(delay)
+            return True
+        self._poll_failures = 0
+        self._long_poll = bool(batch.get("long_poll"))
+        if batch.get("truncated"):
+            # the replay buffer overflowed past our cursor: events were
+            # LOST, not delayed. Same exposure as a cursor regression —
+            # resync everything an event could have carried.
+            log.info(
+                "event buffer overflowed past cursor %s; resyncing "
+                "runs/kills/sessions", self._cursor,
+            )
+            self._cursor = batch["cursor"]
+            self._heal()
         if batch["cursor"] < self._cursor:
             # the hub's sequence counter runs BEHIND our watermark: the
             # server restarted (in-memory hub, fresh counter). Keeping the
-            # old watermark would filter out every future event forever.
-            # Adopt the new sequence space and resync EVERYTHING an event
-            # could have carried: queued runs, kills (a missed kill-task
-            # would let a killed run execute to completion), and deleted
-            # sessions (a missed session-deleted leaves extracted
-            # dataframes on disk) — runs have the periodic sweep as
-            # backstop, kills and sessions only have this.
+            # old watermark would filter out every future event forever —
+            # adopt the new sequence space and heal (see _heal).
             log.info(
                 "event cursor regressed %s -> %s (server restart); "
                 "resyncing runs/kills/sessions", self._cursor,
                 batch["cursor"],
             )
             self._cursor = batch["cursor"]
-            for heal in (self._sync_missed_runs, self._sync_kills,
-                         self._reconcile_sessions):
-                try:
-                    heal()
-                except Exception as e:
-                    log.warning("post-restart %s failed: %s",
-                                heal.__name__, e)
+            # a restarted server also lost its capability answer
+            self._long_poll = None
+            self._batch_ok = (
+                None if self.transport == "batched" else False
+            )
+            self._heal()
         else:
             self._cursor = max(self._cursor, batch["cursor"])
         for event in batch["data"]:
             self._handle(event)
+        return use_wait and bool(batch.get("long_poll"))
+
+    def _heal(self) -> None:
+        """Resync everything an event could have carried: queued runs,
+        kills (a missed kill-task would let a killed run execute to
+        completion), and deleted sessions (a missed session-deleted leaves
+        extracted dataframes on disk) — runs have the periodic sweep as
+        backstop, kills and sessions only have this."""
+        for heal in (self._sync_missed_runs, self._sync_kills,
+                     self._reconcile_sessions):
+            try:
+                heal()
+            except Exception as e:
+                log.warning("event-gap %s failed: %s", heal.__name__, e)
 
     def _listen_ws(self, ws_url: str) -> None:
         import json as _json
@@ -407,11 +683,13 @@ class NodeDaemon:
             # drop the LOCAL dataframe store for the deleted workspace
             self.runner.drop_session(data["session_id"])
 
-    def _submit(self, run_id: int) -> None:
+    def _submit(self, run_id: int, entry: dict[str, Any] | None = None) -> None:
         with self._claim_lock:
             if run_id in self._claimed:
                 return
             self._claimed.add(run_id)
+            if entry is not None:
+                self._prefetched[run_id] = entry
         self._pool.submit(self._execute_logged, run_id)
 
     def _unclaim(self, run_id: int) -> None:
@@ -420,6 +698,7 @@ class NodeDaemon:
         orphaned for this daemon's whole life."""
         with self._claim_lock:
             self._claimed.discard(run_id)
+            self._prefetched.pop(run_id, None)
 
     def _execute_logged(self, run_id: int, dispatched: bool = False) -> None:
         try:
@@ -590,9 +869,37 @@ class NodeDaemon:
 
         Serialized by ``_sync_lock``: the periodic sweep and a
         post-restart resync must not interleave claim-check -> PATCH.
+
+        Against a batch-capable server the WHOLE sweep — orphan reset plus
+        pending claim, with run/task/token prefetched — is one
+        ``claim-batch`` request per 250 runs instead of the page-walking
+        per-run reset loop below (which remains the mixed-version path).
         """
         with self._sync_lock:
+            if self.transport == "batched" and self._batch_ok is not False:
+                try:
+                    if self._claim_batch_sweep():
+                        return
+                except Exception as e:
+                    log.warning(
+                        "batched claim sweep failed (%s); falling back to "
+                        "the per-run sweep", e,
+                    )
             self._sync_missed_runs_locked()
+
+    def _claim_batch_sweep(self) -> bool:
+        """Sweep via claim-batch; False when the server lacks the endpoint
+        (the caller then runs the legacy per-run sweep)."""
+        while True:
+            entries = self._claim_batch(reset_orphans=True)
+            if entries is None:
+                return False
+            for entry in entries:
+                self._submit(entry["id"], entry)
+            if len(entries) < 250:
+                return True
+            # a full page: newly claimed ids join the exclude list, so the
+            # next request returns the NEXT slice of the backlog
 
     def _sync_kills(self) -> None:
         """Re-learn kills this node may have missed (post-restart heal):
@@ -718,15 +1025,41 @@ class NodeDaemon:
 
     # --------------------------------------------------------------- execute
     def _execute(self, run_id: int, dispatched: bool = False) -> None:
-        try:
-            run = self.request("GET", f"run/{run_id}")
-        except Exception as e:
-            log.error("cannot fetch run %s: %s", run_id, e)
-            self._unclaim(run_id)  # still pending server-side: retryable
-            return
+        with self._claim_lock:
+            pre = self._prefetched.pop(run_id, None)
+        prefetched_token: str | None = None
+        if pre is None and self.transport == "batched" \
+                and self._batch_ok is not False:
+            # event-dispatch fast path: run + task + container token in ONE
+            # request instead of GET run / GET task / POST token/container
+            try:
+                entries = self._claim_batch(run_ids=[run_id], max_runs=1)
+            except Exception as e:
+                log.error("cannot fetch run %s: %s", run_id, e)
+                self._unclaim(run_id)  # still pending server-side: retryable
+                return
+            if entries is not None:
+                if not entries:
+                    # not pending anymore (or gone): same outcome as the
+                    # per-run status check below
+                    return
+                pre = entries[0]
+        if pre is not None:
+            run = pre
+            task = pre["task"]
+            prefetched_token = pre.get("container_token")
+        else:
+            try:
+                run = self.request("GET", f"run/{run_id}")
+            except Exception as e:
+                log.error("cannot fetch run %s: %s", run_id, e)
+                self._unclaim(run_id)  # still pending server-side: retryable
+                return
+            if run["status"] != TaskStatus.PENDING.value:
+                return
+            task = self.request("GET", f"task/{run['task']['id']}")
         if run["status"] != TaskStatus.PENDING.value or run_id in self._killed:
             return
-        task = self.request("GET", f"task/{run['task']['id']}")
         if (
             task.get("engine") == "device"
             and self.runner.device_engine
@@ -734,13 +1067,17 @@ class NodeDaemon:
         ):
             # re-route to the dedicated ordered device worker (see __init__);
             # an UNconfigured node falls through so the runner records the
-            # PolicyViolation as NOT_ALLOWED
+            # PolicyViolation as NOT_ALLOWED. The prefetched claim goes back
+            # so the device worker's later _execute reuses it.
+            if pre is not None:
+                with self._claim_lock:
+                    self._prefetched[run_id] = pre
             self._device_queue.put((task["id"], run_id))
             return
 
         def patch(**kw: Any) -> None:
             try:
-                self.request("PATCH", f"run/{run_id}", kw)
+                self._report(run_id, **kw)
             except RuntimeError as e:
                 # 409 = the server already moved the run to a terminal state
                 # (killed mid-execution); the server's word is final
@@ -803,7 +1140,7 @@ class NodeDaemon:
         try:
             # everything after ACTIVE must record its failure, or the run
             # sticks ACTIVE forever while the researcher polls
-            token = self.request(
+            token = prefetched_token or self.request(
                 "POST",
                 "token/container",
                 {"task_id": task["id"], "image": task["image"]},

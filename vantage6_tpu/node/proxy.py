@@ -12,9 +12,8 @@ from __future__ import annotations
 import base64
 from typing import Any
 
-import requests as _requests
-
 from vantage6_tpu.common.encryption import CryptorBase
+from vantage6_tpu.common.rest import pooled_request
 from vantage6_tpu.common.log import setup_logging
 from vantage6_tpu.server.web import App, AppServer, HTTPError, Request
 
@@ -50,12 +49,17 @@ class NodeProxy:
         token = req.bearer_token
         if not token:
             raise HTTPError(401, "container token required")
-        resp = _requests.request(
+        # shared keep-alive pool: every relayed call rides a warm socket;
+        # the timeout outlasts the server's 25 s long-poll cap so a
+        # forwarded event wait completes but a dead server can't wedge a
+        # relay thread forever
+        resp = pooled_request(
             method,
             f"{self.server_url}/api/{endpoint.lstrip('/')}",
-            json=json_body,
+            json_body=json_body,
             params={k: v[0] for k, v in req.query.items()},
             headers={"Authorization": f"Bearer {token}"},
+            timeout=60.0,
         )
         body = resp.json() if resp.content else {}
         if resp.status_code >= 400:
@@ -170,6 +174,13 @@ class NodeProxy:
         @app.route("/api/organization", methods=("GET",))
         def organizations(req: Request):
             return self._forward(req, "GET", "organization")
+
+        @app.route("/api/event", methods=("GET",))
+        def events(req: Request):
+            # event long-poll relay: a central algorithm's
+            # wait_for_results blocks HERE (query params — since/wait —
+            # pass through) and wakes on its subtasks' status events
+            return self._forward(req, "GET", "event")
 
         @app.route("/api/health", methods=("GET",))
         def health(req: Request):

@@ -67,6 +67,14 @@ def run_lifecycle(run: Any) -> dict[str, Any]:
         out["queue_wait_s"] = max(0.0, run.started_at - queued)
         if run.finished_at is not None:
             out["exec_s"] = run.finished_at - run.started_at
+    # control-plane dispatch latency: assignment (task creation fanned the
+    # run out) → execution start. On the host path this equals
+    # queue_wait_s; on the daemon path it additionally contains event
+    # propagation + claim round-trips — the quantity the control_plane
+    # bench leg drives down
+    assigned = getattr(run, "assigned_at", None)
+    if assigned is not None and run.started_at is not None:
+        out["dispatch_latency_s"] = max(0.0, run.started_at - assigned)
     # on-wire payload sizes (estimated v2 frame bytes, see
     # serialization.wire_nbytes) — present when the federation measured
     # them; the straggler view uses these to tell a station that computes
@@ -133,6 +141,16 @@ def wire_stats_snapshot() -> dict[str, Any]:
     from vantage6_tpu.common.serialization import WIRE_STATS
 
     return WIRE_STATS.snapshot()
+
+
+def rest_stats_snapshot() -> dict[str, Any]:
+    """Process-wide REST transport counters (calls, request/response
+    bytes, seconds, stale-socket retries) from `common.rest.REST_STATS`.
+    Diff two snapshots to scope to one round/bench arm — the control_plane
+    leg reports calls-per-task from exactly this."""
+    from vantage6_tpu.common.rest import REST_STATS
+
+    return REST_STATS.snapshot()
 
 
 def device_peak_bytes(device: Any = None) -> int | None:

@@ -36,6 +36,13 @@ class ServerApp:
         self.default_roles = self.pm.ensure_default_roles()
         self.tokens = TokenAuthority(jwt_secret)
         self.hub = EventHub()
+        # hot-path caches (server/cache.py): token→principal resolution and
+        # org→collaborations visibility. Explicitly invalidated by the
+        # mutating endpoints in resources.py; short TTL as backstop.
+        from vantage6_tpu.server.cache import AuthCache, VisibilityCache
+
+        self.auth_cache = AuthCache()
+        self.vis_cache = VisibilityCache()
         # account recovery mail (reference: SMTP; pluggable here — the
         # default LogMailer records messages for dev/test deployments)
         from vantage6_tpu.server.mail import LogMailer

@@ -48,7 +48,14 @@ class Database:
         self._memory_lock = threading.RLock()
 
     def _connect(self) -> sqlite3.Connection:
-        conn = sqlite3.connect(self.path, check_same_thread=False)
+        # cached_statements: sqlite3 keeps per-connection PREPARED
+        # statements keyed by SQL text; the generated CRUD SQL is highly
+        # repetitive (one shape per model/filter combination), so a larger
+        # cache keeps the whole hot set compiled across the federation's
+        # polling/batch sweeps instead of re-parsing per request
+        conn = sqlite3.connect(
+            self.path, check_same_thread=False, cached_statements=256
+        )
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA foreign_keys = ON")
         conn.execute("PRAGMA journal_mode = WAL")
@@ -132,7 +139,38 @@ class Model:
         return db
 
     @classmethod
+    def _sql_columns(cls) -> frozenset[str]:
+        """Column names that may appear in generated SQL (where/order).
+
+        Derived from PRAGMA table_info on first use (covers legacy columns
+        an old database may carry beyond COLUMNS) and cached per class.
+        Defense-in-depth for the f-string SQL assembly in list/first/count:
+        a bad kwarg fails HERE with a clear TypeError naming the field,
+        before any SQL string is built.
+        """
+        cached = cls.__dict__.get("_SQL_COLUMNS")
+        if cached is None:
+            have = {
+                r["name"]
+                for r in cls._db().query(f"PRAGMA table_info({cls.TABLE})")
+            }
+            cached = frozenset(have | set(cls.COLUMNS) | {"id", "created_at"})
+            cls._SQL_COLUMNS = cached  # per-class, not inherited
+        return cached
+
+    @classmethod
+    def _check_columns(cls, names: Iterable[str], what: str) -> None:
+        unknown = [n for n in names if n not in cls._sql_columns()]
+        if unknown:
+            raise TypeError(
+                f"{cls.__name__}: unknown {what} column(s) {sorted(unknown)} "
+                f"(known: {sorted(cls._sql_columns())})"
+            )
+
+    @classmethod
     def ensure_schema(cls) -> None:
+        if "_SQL_COLUMNS" in cls.__dict__:
+            delattr(cls, "_SQL_COLUMNS")  # re-derive after DDL
         cols = ", ".join(
             f'"{name}" {_TYPES[t]}' for name, t in cls.COLUMNS.items()
         )
@@ -225,6 +263,11 @@ class Model:
         offset: int = 0,
         **where: Any,
     ) -> list[T]:
+        cls._check_columns(where, "where")
+        order_col, _, order_dir = order.partition(" ")
+        cls._check_columns([order_col], "order")
+        if order_dir and order_dir.lower() not in ("asc", "desc"):
+            raise TypeError(f"{cls.__name__}: bad order direction {order!r}")
         sql = f"SELECT * FROM {cls.TABLE}"
         params: list[Any] = []
         if where:
@@ -249,6 +292,7 @@ class Model:
 
     @classmethod
     def count(cls, **where: Any) -> int:
+        cls._check_columns(where, "where")
         sql = f"SELECT COUNT(*) AS n FROM {cls.TABLE}"
         params: list[Any] = []
         if where:

@@ -49,12 +49,25 @@ class Event:
 
 
 class EventHub:
-    """Thread-safe pub/sub with a bounded replay buffer."""
+    """Thread-safe pub/sub with a bounded replay buffer.
+
+    Blocking consumers (the REST long-poll, `wait_for`) ride a condition
+    variable notified on every emit, so a waiting daemon/client wakes the
+    moment an event lands instead of on its next polling sweep. Eviction
+    is tracked (`evicted_through`): a `fetch(since=...)` whose cursor
+    predates the oldest buffered event has MISSED events the buffer can no
+    longer replay — consumers must resync from primary state, and the
+    REST layer surfaces this as `truncated` so they know to.
+    """
 
     def __init__(self, buffer_size: int = 4096):
+        self.buffer_size = buffer_size
         self._buffer: deque[Event] = deque(maxlen=buffer_size)
         self._seq = itertools.count(1)
         self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        # seq of the newest event the bounded buffer has DROPPED (0: none)
+        self._evicted_through = 0
         # subscriber id -> (rooms | None for all, callback)
         self._subs: dict[int, tuple[set[str] | None, Callable[[Event], None]]] = {}
         self._sub_ids = itertools.count(1)
@@ -66,7 +79,12 @@ class EventHub:
                 seq=next(self._seq), name=name, room=room,
                 data=data, ts=time.time(),
             )
+            if len(self._buffer) == self.buffer_size:
+                # deque(maxlen) silently drops the head; remember how far
+                # the replay window has moved so fetch() can report gaps
+                self._evicted_through = self._buffer[0].seq
             self._buffer.append(ev)
+            self._cond.notify_all()
             subs = list(self._subs.values())
         for rooms, cb in subs:
             if rooms is None or room in rooms or room == "all":
@@ -101,13 +119,87 @@ class EventHub:
         whatever it missed.
         """
         with self._lock:
-            want = set(rooms) if rooms is not None else None
-            return [
-                ev
-                for ev in self._buffer
-                if ev.seq > since
-                and (want is None or ev.room in want or ev.room == "all")
-            ]
+            return self._fetch_locked(since, rooms, None)
+
+    def _fetch_locked(
+        self,
+        since: int,
+        rooms: list[str] | None,
+        names: set[str] | None,
+    ) -> list[Event]:
+        want = set(rooms) if rooms is not None else None
+        return [
+            ev
+            for ev in self._buffer
+            if ev.seq > since
+            and (want is None or ev.room in want or ev.room == "all")
+            and (names is None or ev.name in names)
+        ]
+
+    def wait_for(
+        self,
+        since: int = 0,
+        rooms: list[str] | None = None,
+        timeout: float = 0.0,
+        names: set[str] | None = None,
+    ) -> list[Event]:
+        """`fetch`, but blocks up to `timeout` seconds until at least one
+        matching event exists — the long-poll primitive. Returns [] on
+        timeout. Wakes IMMEDIATELY on a matching emit (condition variable),
+        so dispatch latency is event propagation, not polling cadence.
+
+        `names` narrows the wake set: a daemon only dispatches on
+        task-created/kill-task/session-deleted, and without the filter
+        every status-update in its collaboration would wake all N daemons
+        — an N× request amplification per event under load.
+        """
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            while True:
+                events = self._fetch_locked(since, rooms, names)
+                if events:
+                    return events
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+
+    def collect(
+        self,
+        since: int = 0,
+        rooms: list[str] | None = None,
+        timeout: float = 0.0,
+        names: set[str] | None = None,
+    ) -> tuple[list[Event], int, bool]:
+        """ATOMIC (events, cursor, truncated) snapshot, blocking like
+        `wait_for`. The cursor is read under the SAME lock as the event
+        scan, so it covers exactly the events visible to this snapshot —
+        reading `hub.cursor` after a separate fetch would cover an event
+        emitted in the gap without delivering it, and a cursor-following
+        consumer (the daemon) would then skip it forever."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            while True:
+                events = self._fetch_locked(since, rooms, names)
+                if events:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            cursor = self._buffer[-1].seq if self._buffer else 0
+            return events, cursor, since < self._evicted_through
+
+    def truncated(self, since: int) -> bool:
+        """Whether a consumer at cursor `since` has missed events the
+        bounded buffer can no longer replay (buffer overflow)."""
+        with self._lock:
+            return since < self._evicted_through
+
+    @property
+    def evicted_through(self) -> int:
+        with self._lock:
+            return self._evicted_through
 
     @property
     def cursor(self) -> int:

@@ -95,12 +95,29 @@ def _m4_hot_query_indexes(db: Database) -> None:
     )
 
 
+def _m5_dispatch_indexes(db: Database) -> None:
+    """v5: composite indexes for the control-plane fast path — the batched
+    claim sweep selects runs by (organization, status) and by
+    (node, status); the single-column idx_run_status from v4 still forces
+    a scan over every completed run of a busy org."""
+    db.execute(
+        "CREATE INDEX IF NOT EXISTS idx_run_org_status "
+        "ON run(organization_id, status)"
+    )
+    db.execute(
+        "CREATE INDEX IF NOT EXISTS idx_run_node_status "
+        "ON run(node_id, status)"
+    )
+
+
 MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
     (1, "baseline schema", _m1_baseline),
     (2, "unique index on user.username (+dedupe)", _m2_unique_username),
     (3, "unique index on organization.name (+dedupe)", _m3_unique_org_name),
     (4, "hot-query indexes: run.status, task.job_id, node uniqueness",
      _m4_hot_query_indexes),
+    (5, "dispatch-path indexes: run(org,status), run(node,status)",
+     _m5_dispatch_indexes),
 ]
 
 SCHEMA_VERSION = MIGRATIONS[-1][0]

@@ -163,7 +163,16 @@ class User(Model):
         user_role.add(self.id, role.id)
 
     def rule_ids(self) -> set[int]:
-        """All rules: direct extra rules + via roles."""
+        """All rules: direct extra rules + via roles.
+
+        `_rules_cache` (set by the auth cache on token resolution) skips
+        the 1+R link-table queries per permission check; role/rule
+        mutations invalidate the auth cache, which drops the cached user
+        object and this snapshot with it.
+        """
+        cached = getattr(self, "_rules_cache", None)
+        if cached is not None:
+            return set(cached)
         rules = set(user_rule.rights_for(self.id))
         for rid in self.role_ids():
             rules.update(role_rule.rights_for(rid))

@@ -27,9 +27,20 @@ if TYPE_CHECKING:  # pragma: no cover
 
 def identity_from_token(srv: "ServerApp", token: str | None) -> tuple[str, Any]:
     """Resolve a bearer token to (kind, principal); raises HTTPError(401).
-    Shared by the REST auth path and the websocket bridge."""
+    Shared by the REST auth path and the websocket bridge.
+
+    Resolutions are cached (`srv.auth_cache`, token → principal, with the
+    user's rule-id set precomputed) so a polling daemon or batching client
+    pays the JWT verify + principal/rule queries once per TTL, not per
+    request. Every mutation that could change the answer — credential
+    rotation, role/rule edits, principal deletion — explicitly invalidates
+    (see the endpoints below); the entry also dies at the token's own exp.
+    """
     if not token:
         raise HTTPError(401, "missing bearer token")
+    cached = srv.auth_cache.get(token)
+    if cached is not None:
+        return cached
     try:
         sub, claims = srv.tokens.identity_claims(token)
     except AuthError as e:
@@ -45,15 +56,36 @@ def identity_from_token(srv: "ServerApp", token: str | None) -> tuple[str, Any]:
             # credentials rotated after issuance: the session is dead —
             # this is what makes a password change evict a stolen session
             raise HTTPError(401, "token superseded by a credential change")
+        # warm the rule set so permission checks on this cached principal
+        # cost zero queries (User.rule_ids honors _rules_cache)
+        user._rules_cache = frozenset(user.rule_ids())
+        srv.auth_cache.put(token, "user", user, claims.get("exp"))
         return "user", user
     if kind == "node":
         node = m.Node.get(sub["id"])
         if node is None:
             raise HTTPError(401, "unknown node")
+        srv.auth_cache.put(token, "node", node, claims.get("exp"))
         return "node", node
     if kind == "container":
+        srv.auth_cache.put(token, "container", sub, claims.get("exp"))
         return "container", sub
     raise HTTPError(401, "unknown principal type")
+
+
+def _visible_collab_ids(srv: "ServerApp", org_id: int) -> frozenset[int]:
+    """Collaboration ids containing `org_id` — THE visibility check the
+    listing endpoints and event-room scoping previously re-derived from a
+    full Collaboration scan per request (and per run, in the run listing).
+    Cached on the server; invalidated on any membership mutation."""
+    cached = srv.vis_cache.get(org_id)
+    if cached is not None:
+        return cached
+    ids = frozenset(
+        c.id for c in m.Collaboration.list() if org_id in c.organization_ids()
+    )
+    srv.vis_cache.put(org_id, ids)
+    return ids
 
 
 def _identity(srv: "ServerApp", req: Request) -> tuple[str, Any]:
@@ -303,6 +335,8 @@ def register_resources(srv: "ServerApp") -> None:
         user.set_password(body["password"])
         user.failed_login_attempts = 0
         user.save()
+        # the fingerprint rotation must bite NOW, not at cache TTL
+        srv.auth_cache.invalidate_principal("user", user.id)
         return {"msg": "password updated"}
 
     @app.route("/api/password/change", methods=("POST",))
@@ -324,6 +358,8 @@ def register_resources(srv: "ServerApp") -> None:
         user.set_password(body["new_password"])
         user.failed_login_attempts = 0
         user.save()
+        # every outstanding token (incl. a cached attacker session) dies now
+        srv.auth_cache.invalidate_principal("user", user.id)
         return {"msg": "password updated — all sessions are now invalid; "
                        "log in again"}
 
@@ -361,6 +397,7 @@ def register_resources(srv: "ServerApp") -> None:
         user = _user_for_reset_token(srv, body["reset_token"])
         user.totp_secret = generate_totp_secret()
         user.save()
+        srv.auth_cache.invalidate_principal("user", user.id)
         # the new secret is returned ONCE for authenticator re-enrollment
         return {"totp_secret": user.totp_secret}
 
@@ -420,6 +457,7 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             )
             target.delete()
+            srv.auth_cache.invalidate_principal("user", target.id)
             return {}, 204
         _check(
             pm.allowed(
@@ -448,6 +486,8 @@ def register_resources(srv: "ServerApp") -> None:
             for role in roles:
                 m.user_role.add(target.id, role.id)
         target.save()
+        # fields/credentials/roles may all have changed: drop cached tokens
+        srv.auth_cache.invalidate_principal("user", target.id)
         return target.to_dict()
 
     # ------------------------------------------------------- organizations
@@ -465,10 +505,12 @@ def register_resources(srv: "ServerApp") -> None:
                     ]
                 elif scope == Scope.COLLABORATION:
                     visible: set[int] = {principal.organization_id}
-                    for c in m.Collaboration.list():
-                        ids = c.organization_ids()
-                        if principal.organization_id in ids:
-                            visible.update(ids)
+                    for cid in _visible_collab_ids(
+                        srv, principal.organization_id
+                    ):
+                        visible.update(
+                            m.Collaboration.get(cid).organization_ids()
+                        )
                     rows = [o for o in rows if o.id in visible]
                 return _paginate(req, rows)
             # nodes/containers see their collaboration's organizations (needed
@@ -499,9 +541,11 @@ def register_resources(srv: "ServerApp") -> None:
                         organization_id=org.id,
                     )
                     or any(
-                        principal.organization_id in c.organization_ids()
-                        and org.id in c.organization_ids()
-                        for c in m.Collaboration.list()
+                        org.id
+                        in m.Collaboration.get(cid).organization_ids()
+                        for cid in _visible_collab_ids(
+                            srv, principal.organization_id
+                        )
                     )
                 )
             else:
@@ -572,6 +616,7 @@ def register_resources(srv: "ServerApp") -> None:
         ).save()
         for oid in body["organization_ids"]:
             collab.add_organization(_get_or_404(m.Organization, oid))
+        srv.vis_cache.invalidate_all()
         return collab.to_dict(), 201
 
     @app.route("/api/collaboration/<int:id>", methods=("GET", "PATCH", "DELETE"))
@@ -603,6 +648,7 @@ def register_resources(srv: "ServerApp") -> None:
                 == Scope.GLOBAL
             )
             collab.delete()
+            srv.vis_cache.invalidate_all()
             return {}, 204
         _check(
             pm.allowed(
@@ -615,8 +661,10 @@ def register_resources(srv: "ServerApp") -> None:
         if "encrypted" in body:
             collab.encrypted = body["encrypted"]
         collab.save()
-        for oid in body.get("organization_ids") or []:
-            collab.add_organization(_get_or_404(m.Organization, oid))
+        if body.get("organization_ids"):
+            for oid in body["organization_ids"]:
+                collab.add_organization(_get_or_404(m.Organization, oid))
+            srv.vis_cache.invalidate_all()
         return collab.to_dict()
 
     # -------------------------------------------------------------- studies
@@ -872,6 +920,7 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             )
             node.delete()
+            srv.auth_cache.invalidate_principal("node", node.id)
             return {}, 204
         _check(
             pm.allowed(
@@ -895,11 +944,9 @@ def register_resources(srv: "ServerApp") -> None:
                 _check(scope is not None)
                 rows = m.Task.list()
                 if scope != Scope.GLOBAL:
-                    visible_collabs = {
-                        c.id
-                        for c in m.Collaboration.list()
-                        if principal.organization_id in c.organization_ids()
-                    }
+                    visible_collabs = _visible_collab_ids(
+                        srv, principal.organization_id
+                    )
                     rows = [
                         t
                         for t in rows
@@ -1045,19 +1092,26 @@ def register_resources(srv: "ServerApp") -> None:
         if status is not None:
             where["status"] = status
         rows = m.TaskRun.list(**where)
+        # request-scoped task memo: the visibility filters below resolve
+        # the task of EVERY run — without this, a busy listing is an N+1
+        # query storm (one Task.get per run, most of them duplicates)
+        tasks: dict[int, m.Task | None] = {}
+
+        def _task_of(r: m.TaskRun) -> m.Task | None:
+            if r.task_id not in tasks:
+                tasks[r.task_id] = m.Task.get(r.task_id)
+            return tasks[r.task_id]
+
         if kind == "user":
             scope = pm.user_scope(principal, "run", Operation.VIEW)
             _check(scope is not None)
             if scope != Scope.GLOBAL:
-                visible = {
-                    c.id
-                    for c in m.Collaboration.list()
-                    if principal.organization_id in c.organization_ids()
-                }
+                visible = _visible_collab_ids(srv, principal.organization_id)
                 rows = [
                     r
                     for r in rows
-                    if m.Task.get(r.task_id).collaboration_id in visible
+                    if (t := _task_of(r)) is not None
+                    and t.collaboration_id in visible
                 ]
         elif kind == "node":
             # org AND collaboration: a node is per (org, collaboration), and
@@ -1066,8 +1120,8 @@ def register_resources(srv: "ServerApp") -> None:
             rows = [
                 r for r in rows
                 if r.organization_id == principal.organization_id
-                and m.Task.get(r.task_id).collaboration_id
-                == principal.collaboration_id
+                and (t := _task_of(r)) is not None
+                and t.collaboration_id == principal.collaboration_id
             ]
         else:  # container: runs of its own task tree (job) only
             own_job = _container_task(principal).job_id
@@ -1100,40 +1154,160 @@ def register_resources(srv: "ServerApp") -> None:
         # PATCH: only the executing node updates status/result (org AND
         # collaboration — same scoping as the node's run listing)
         node = _require_node(srv, req)
-        _check(
-            run.organization_id == node.organization_id
-            and task.collaboration_id == node.collaboration_id
-        )
         body = sch.load(sch.RunPatch(), req.json)
-        if (
-            body["status"]
-            and run.status
-            and TaskStatus(run.status).is_finished
-        ):
-            # terminal states are immutable: a node finishing late must not
-            # overwrite KILLED (or re-open a completed run)
-            raise HTTPError(
-                409, f"run {run.id} already {run.status}; cannot change"
+        return _apply_run_patch(srv, node, run, task, body)
+
+    @app.route("/api/run/claim-batch", methods=("POST",))
+    def run_claim_batch(req: Request):
+        """Batched node dispatch: the whole claim sweep in ONE request.
+
+        Sweep mode (no `run_ids`): optionally re-queue this node's
+        INITIALIZING/ACTIVE orphans (excluding `exclude_run_ids` — the
+        runs the daemon is executing right now), then return up to `max`
+        claimable PENDING runs. Dispatch mode (`run_ids`): return exactly
+        those runs if still pending and in scope. Either way each entry
+        carries the run, its full task, and a pre-minted container token —
+        collapsing the daemon's per-run GET run + GET task +
+        POST token/container round-trips into none.
+
+        "Claiming" mints no lease: runs stay PENDING until the daemon
+        PATCHes them ACTIVE, exactly as on the per-run path, so an
+        un-upgraded daemon (or a restarted one) interoperates unchanged —
+        idempotency still comes from the daemon's claim set plus the
+        terminal-status 409 guard.
+        """
+        node = _require_node(srv, req)
+        body = sch.load(sch.ClaimBatchInput(), req.json)
+        exclude = set(body["exclude_run_ids"] or [])
+        tasks: dict[int, m.Task | None] = {}
+
+        def _task_of(run: m.TaskRun) -> m.Task | None:
+            if run.task_id not in tasks:
+                tasks[run.task_id] = m.Task.get(run.task_id)
+            return tasks[run.task_id]
+
+        def _in_scope(run: m.TaskRun) -> bool:
+            t = _task_of(run)
+            return (
+                t is not None
+                and run.organization_id == node.organization_id
+                and t.collaboration_id == node.collaboration_id
             )
-        for field in ("status", "result", "log", "started_at", "finished_at"):
-            if body[field] is not None:
-                setattr(run, field, body[field])
-        if body["status"] and run.node_id is None:
-            run.node_id = node.id
-        run.save()
-        if body["status"]:
-            srv.hub.emit(
-                ev.STATUS_UPDATE,
-                {
-                    "task_id": task.id,
-                    "run_id": run.id,
-                    "status": run.status,
-                    "organization_id": run.organization_id,
-                    "task_status": task.status(),
-                },
-                room=ev.collaboration_room(task.collaboration_id),
+
+        claimable: list[m.TaskRun] = []
+        if body["run_ids"] is not None:
+            # explicit dispatch: `exclude_run_ids` does not apply — the
+            # daemon claims BEFORE fetching, so its own id is in there
+            for rid in body["run_ids"][: body["max"]]:
+                run = m.TaskRun.get(rid)
+                # batch semantics: out-of-scope / non-pending entries are
+                # silently skipped, not errors — the daemon treats absence
+                # as "nothing to execute" (same as a non-pending GET run)
+                if (
+                    run is None
+                    or not _in_scope(run)
+                    or run.status != TaskStatus.PENDING.value
+                ):
+                    continue
+                claimable.append(run)
+        else:
+            n_reset = 0
+            if body["reset_orphans"]:
+                for status in (TaskStatus.INITIALIZING, TaskStatus.ACTIVE):
+                    for run in m.TaskRun.list(
+                        status=status.value,
+                        organization_id=node.organization_id,
+                    ):
+                        if run.id in exclude or not _in_scope(run):
+                            continue
+                        # conditional UPDATE, not save(): between the
+                        # listing and this write the run may have been
+                        # COMPLETED by a concurrent report — a stale
+                        # full-row save would clobber the result and
+                        # re-queue finished work. The status guard makes
+                        # the reset atomic; rowcount 0 = someone else
+                        # moved the run on, leave it alone.
+                        cur = m.TaskRun._db().execute(
+                            f"UPDATE {m.TaskRun.TABLE} "
+                            "SET status = ?, log = ? "
+                            "WHERE id = ? AND status = ?",
+                            [
+                                TaskStatus.PENDING.value,
+                                "orphaned mid-run (daemon restart or "
+                                "lost report); re-queued by claim-batch",
+                                run.id,
+                                status.value,
+                            ],
+                        )
+                        if cur.rowcount == 0:
+                            continue
+                        n_reset += 1
+                        task = _task_of(run)
+                        srv.hub.emit(
+                            ev.STATUS_UPDATE,
+                            {
+                                "task_id": task.id,
+                                "run_id": run.id,
+                                "status": TaskStatus.PENDING.value,
+                                "organization_id": run.organization_id,
+                                "task_status": task.status(),
+                            },
+                            room=ev.collaboration_room(task.collaboration_id),
+                        )
+            for run in m.TaskRun.list(
+                status=TaskStatus.PENDING.value,
+                organization_id=node.organization_id,
+            ):
+                if run.id in exclude or not _in_scope(run):
+                    continue
+                claimable.append(run)
+                if len(claimable) >= body["max"]:
+                    break
+        data = []
+        for run in claimable:
+            task = _task_of(run)
+            entry = run.to_dict()
+            entry["task"] = task.to_dict()
+            entry["container_token"] = srv.tokens.container_token(
+                node_id=node.id,
+                task_id=task.id,
+                image=task.image,
+                organization_id=node.organization_id,
             )
-        return run.to_dict()
+            data.append(entry)
+        out: dict[str, Any] = {"data": data}
+        if body["run_ids"] is None and body["reset_orphans"]:
+            out["n_reset"] = n_reset
+        return out
+
+    @app.route("/api/run/batch", methods=("PATCH",))
+    def run_patch_batch(req: Request):
+        """Batched status/result upload: N run PATCHes in one request,
+        with PER-ITEM outcomes (200/403/404/409 + msg) so one conflicting
+        run — e.g. killed mid-execution — doesn't fail its batch-mates.
+        Semantics per item are EXACTLY `PATCH /api/run/<id>`, including
+        terminal-state immutability and the status-update event."""
+        node = _require_node(srv, req)
+        body = sch.load(sch.RunBatchPatch(), req.json)
+        results = []
+        for item in body["runs"]:
+            rid = item["id"]
+            run = m.TaskRun.get(rid)
+            if run is None:
+                results.append(
+                    {"id": rid, "status_code": 404, "msg": "not found"}
+                )
+                continue
+            task = m.Task.get(run.task_id)
+            try:
+                _apply_run_patch(srv, node, run, task, item)
+            except HTTPError as e:
+                results.append(
+                    {"id": rid, "status_code": e.status, "msg": e.msg}
+                )
+                continue
+            results.append({"id": rid, "status_code": 200})
+        return {"data": results}
 
     # ------------------------------------------------------------ rbac views
     @app.route("/api/role", methods=("GET", "POST"))
@@ -1172,6 +1346,8 @@ def register_resources(srv: "ServerApp") -> None:
         )
         if req.method == "DELETE":
             role.delete()
+            # the role's rules reached arbitrarily many users: global evict
+            srv.auth_cache.invalidate_all()
             return {}, 204
         body = sch.load(sch.RolePatch(), req.json)
         for field in ("name", "description"):
@@ -1179,6 +1355,7 @@ def register_resources(srv: "ServerApp") -> None:
                 setattr(role, field, body[field])
         if body["rules"] is not None:
             _grant_role_rules(user, role, body["rules"], replace=True)
+            srv.auth_cache.invalidate_all()
         role.save()
         return role.to_dict()
 
@@ -1195,39 +1372,36 @@ def register_resources(srv: "ServerApp") -> None:
             run_id = req.int_arg("run_id")
             where = {"run_id": run_id} if run_id is not None else {}
             rows = m.Port.list(**where)
+            # request-scoped run→collaboration memo (ports of one run share
+            # the same resolution; previously two queries PER PORT)
+            port_collabs: dict[int, int | None] = {}
+
+            def _collab_of(p: m.Port) -> int | None:
+                if p.run_id not in port_collabs:
+                    run = m.TaskRun.get(p.run_id)
+                    task = m.Task.get(run.task_id) if run else None
+                    port_collabs[p.run_id] = (
+                        task.collaboration_id if task else None
+                    )
+                return port_collabs[p.run_id]
+
             # scope to collaborations the principal can see (port VIEW rule
             # for users; own collaboration for nodes/containers)
             if kind == "user":
                 scope = pm.user_scope(principal, "port", Operation.VIEW)
                 _check(scope is not None)
                 if scope != Scope.GLOBAL:
-                    visible = {
-                        c.id
-                        for c in m.Collaboration.list()
-                        if principal.organization_id in c.organization_ids()
-                    }
-                    rows = [
-                        p
-                        for p in rows
-                        if m.Task.get(
-                            m.TaskRun.get(p.run_id).task_id
-                        ).collaboration_id
-                        in visible
-                    ]
+                    visible = _visible_collab_ids(
+                        srv, principal.organization_id
+                    )
+                    rows = [p for p in rows if _collab_of(p) in visible]
             else:
                 own_collab = (
                     principal.collaboration_id
                     if kind == "node"
                     else _container_task(principal).collaboration_id
                 )
-                rows = [
-                    p
-                    for p in rows
-                    if m.Task.get(
-                        m.TaskRun.get(p.run_id).task_id
-                    ).collaboration_id
-                    == own_collab
-                ]
+                rows = [p for p in rows if _collab_of(p) == own_collab]
             return _paginate(req, rows)
         node = _require_node(srv, req)
         body = sch.load(sch.PortInput(), req.json)
@@ -1325,13 +1499,49 @@ def register_resources(srv: "ServerApp") -> None:
     # --------------------------------------------------------------- events
     @app.route("/api/event", methods=("GET",))
     def events_fetch(req: Request):
-        """Cursor catch-up (reference: socket reconnect re-sync)."""
+        """Cursor catch-up (reference: socket reconnect re-sync) — now
+        long-poll capable: `?wait=S` blocks up to S seconds (capped at 25)
+        until an event lands in one of the caller's rooms, waking
+        IMMEDIATELY on emit. `long_poll: true` in the response is how
+        clients detect the capability (an old server ignores the unknown
+        param and returns at once, without the flag — callers then keep
+        their fixed-interval sleeps). `truncated: true` means the bounded
+        replay buffer evicted events past the caller's cursor: the caller
+        MUST resync from primary state (runs/kills/sessions), not trust
+        the event stream alone."""
         kind, principal = _identity(srv, req)
         since = req.int_arg("since", 0)
-        rooms = _rooms_for(kind, principal)
+        raw_wait = req.arg("wait")
+        try:
+            wait = min(25.0, max(0.0, float(raw_wait))) if raw_wait else 0.0
+        except ValueError:
+            raise HTTPError(400, "query param 'wait' must be a number") \
+                from None
+        # optional comma-separated name filter: narrows BOTH the returned
+        # events and (crucially) the long-poll wake set — a daemon must
+        # not wake on every status-update flooding its collaboration room
+        raw_names = req.arg("names")
+        names = (
+            {n for n in raw_names.split(",") if n} if raw_names else None
+        )
+        rooms = _rooms_for(srv, kind, principal)
+        if since < 0:
+            # cursor probe: "where is the stream NOW?" — lets a client
+            # start tailing without replaying the whole buffer first
+            events: list = []
+            cursor, truncated = srv.hub.cursor, False
+        else:
+            # collect() pairs the cursor with the event snapshot
+            # ATOMICALLY — cursor read after a separate fetch could cover
+            # an event emitted in the gap without delivering it
+            events, cursor, truncated = srv.hub.collect(
+                since, rooms, timeout=wait, names=names
+            )
         return {
-            "cursor": srv.hub.cursor,
-            "data": [e.to_dict() for e in srv.hub.fetch(since, rooms)],
+            "cursor": cursor,
+            "data": [e.to_dict() for e in events],
+            "long_poll": True,
+            "truncated": truncated,
         }
 
     @app.route("/api/whoami", methods=("GET",))
@@ -1527,12 +1737,72 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
 # ------------------------------------------------------------------- helpers
 
 
-def _rooms_for(kind: str, principal: Any) -> list[str]:
+def _apply_run_patch(
+    srv: "ServerApp",
+    node: m.Node,
+    run: m.TaskRun,
+    task: m.Task | None,
+    body: dict[str, Any],
+) -> dict[str, Any]:
+    """The one node-updates-a-run core, shared by `PATCH /api/run/<id>`
+    and the batched `PATCH /api/run/batch` (per item). Raises HTTPError;
+    the batch endpoint maps that to a per-item outcome."""
+    if task is None:
+        raise HTTPError(404, "run's task no longer exists")
+    _check(
+        run.organization_id == node.organization_id
+        and task.collaboration_id == node.collaboration_id
+    )
+    if (
+        body["status"]
+        and run.status
+        and TaskStatus(run.status).is_finished
+    ):
+        # terminal states are immutable: a node finishing late must not
+        # overwrite KILLED (or re-open a completed run)
+        raise HTTPError(
+            409, f"run {run.id} already {run.status}; cannot change"
+        )
+    for field in ("status", "result", "log", "started_at", "finished_at"):
+        if body[field] is not None:
+            setattr(run, field, body[field])
+    if body["status"] and run.node_id is None:
+        run.node_id = node.id
+    run.save()
+    if body["status"]:
+        srv.hub.emit(
+            ev.STATUS_UPDATE,
+            {
+                "task_id": task.id,
+                "run_id": run.id,
+                "status": run.status,
+                "organization_id": run.organization_id,
+                "task_status": task.status(),
+            },
+            room=ev.collaboration_room(task.collaboration_id),
+        )
+    return run.to_dict()
+
+
+def _rooms_for(
+    srv: "ServerApp", kind: str, principal: Any
+) -> list[str] | None:
+    """Event rooms for a principal; None = every room (operator view)."""
     if kind == "user":
+        if (
+            srv.pm.user_scope(principal, "event", Operation.RECEIVE)
+            == Scope.GLOBAL
+        ):
+            # a global event-receive holder (root/operators) watches the
+            # whole stream — membership rooms would hide every
+            # collaboration their org hasn't joined, which for root is
+            # ALL of them
+            return None
         return [
-            ev.collaboration_room(c.id)
-            for c in m.Collaboration.list()
-            if principal.organization_id in c.organization_ids()
+            ev.collaboration_room(cid)
+            for cid in sorted(
+                _visible_collab_ids(srv, principal.organization_id)
+            )
         ]
     if kind == "node":
         return [

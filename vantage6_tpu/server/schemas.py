@@ -181,6 +181,35 @@ class RunPatch(_Base):
     finished_at = fields.Float(load_default=None)
 
 
+class ClaimBatchInput(_Base):
+    """POST /api/run/claim-batch — the node sweep/dispatch coalesced."""
+
+    # explicit dispatch: fetch exactly these runs (event fast path);
+    # absent -> sweep mode (all claimable pending runs for the node)
+    run_ids = fields.List(fields.Int(), load_default=None)
+    # runs the daemon is executing right now: never orphan-reset them and
+    # don't re-deliver them in the pending listing
+    exclude_run_ids = fields.List(fields.Int(), load_default=list)
+    # also re-queue INITIALIZING/ACTIVE orphans (anti-entropy sweep mode)
+    reset_orphans = fields.Bool(load_default=False)
+    max = fields.Int(
+        load_default=250, validate=validate.Range(min=1, max=250)
+    )
+
+
+class RunBatchItem(RunPatch):
+    """One entry of PATCH /api/run/batch — RunPatch plus the target id."""
+
+    id = fields.Int(required=True)
+
+
+class RunBatchPatch(_Base):
+    runs = fields.List(
+        fields.Nested(RunBatchItem), required=True,
+        validate=validate.Length(min=1, max=250),
+    )
+
+
 class RoleInput(_Base):
     name = fields.Str(required=True)
     description = fields.Str(load_default="")

@@ -87,7 +87,7 @@ class WebSocketBridge:
             ws.send(json.dumps({"error": e.msg}))
             ws.close(1008, "unauthorized")
             return
-        rooms = _rooms_for(kind, principal)
+        rooms = _rooms_for(self.srv, kind, principal)
         q: queue.Queue = queue.Queue(maxsize=1024)
         overflowed = threading.Event()
 
