@@ -114,6 +114,18 @@ CONTROL_TIMEOUT_S = 420
 CP_DAEMONS = 8
 CP_TASKS = 40
 CP_WIDTH = 2          # organizations targeted per task
+# observability leg (tracing + telemetry PR): the control_plane mini
+# topology run with distributed tracing OFF vs ON (same transport, same
+# tasks), arms ALTERNATED to decorrelate machine noise and best-of per
+# arm compared — the instrumentation must never become the bottleneck it
+# measures (< 5% tasks/sec overhead). The traced arm additionally proves
+# one task's trace covers create→dispatch→claim→exec→report→aggregate,
+# exports valid Perfetto trace_event JSON, and parses GET /metrics.
+OBS_TIMEOUT_S = 420
+OBS_DAEMONS = 4
+OBS_TASKS = 24
+OBS_REPS = 2          # off/on pairs (alternated)
+OBS_OVERHEAD_PCT = 5.0
 # wire_format leg (binary wire PR): v1 JSON+base64 vs v2 framed-binary
 # (de)serialization throughput + on-wire bytes on model-weight pytrees and a
 # DataFrame stats table, plus single-pass broadcast encryption cost when the
@@ -984,6 +996,198 @@ def worker_controlplane() -> None:
     }))
 
 
+def worker_observability() -> None:
+    """observability leg: distributed tracing ON vs OFF, same topology.
+
+    The guardrail for the tracing PR: OBS_DAEMONS batched daemons + one
+    server, OBS_TASKS small partial tasks per arm, arms alternated
+    (off, on, off, on, ...) and compared best-of so a background load
+    spike on the host doesn't masquerade as tracing overhead. The traced
+    arm also asserts the OBSERVABILITY acceptance: one task's trace
+    covers client create → server dispatch → daemon claim → runner exec
+    → result upload → aggregation, exports valid Perfetto trace_event
+    JSON, and the server's /metrics parses with the absorbed series.
+    """
+    _worker_setup()
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from vantage6_tpu.client import UserClient
+    from vantage6_tpu.common.enums import TaskStatus
+    from vantage6_tpu.node.daemon import NodeDaemon
+    from vantage6_tpu.runtime.tracing import (
+        TRACER, summarize, to_trace_events,
+    )
+    from vantage6_tpu.server.app import ServerApp
+
+    n_daemons = int(os.environ.get("BENCH_OBS_DAEMONS", str(OBS_DAEMONS)))
+    n_tasks = int(os.environ.get("BENCH_OBS_TASKS", str(OBS_TASKS)))
+    image, module = "v6-average-py", "vantage6_tpu.workloads.average"
+
+    tmp = tempfile.mkdtemp(prefix="v6t-obs-bench-")
+    rng = np.random.default_rng(11)
+    csvs = []
+    for i in range(n_daemons):
+        path = os.path.join(tmp, f"s{i:02d}.csv")
+        pd.DataFrame(
+            {"age": rng.uniform(20, 80, 32).round(1)}
+        ).to_csv(path, index=False)
+        csvs.append(path)
+
+    def arm(tracing_on: bool, arm_tag: str) -> dict:
+        TRACER.configure(enabled=tracing_on, sample=1.0)
+        TRACER.clear()
+        srv = ServerApp()
+        srv.ensure_root(password="rootpass123")
+        http = srv.serve(port=0, background=True)
+        client = UserClient(http.url)
+        client.authenticate("root", "rootpass123")
+        orgs = [
+            client.organization.create(name=f"obs-{arm_tag}-{i:02d}")
+            for i in range(n_daemons)
+        ]
+        collab = client.collaboration.create(
+            name=f"obs-{arm_tag}",
+            organization_ids=[o["id"] for o in orgs],
+        )
+        daemons = []
+        for i, org in enumerate(orgs):
+            ni = client.node.create(
+                organization_id=org["id"], collaboration_id=collab["id"]
+            )
+            d = NodeDaemon(
+                api_url=http.url,
+                api_key=ni["api_key"],
+                algorithms={image: module},
+                databases=[
+                    {"label": "default", "type": "csv", "uri": csvs[i]}
+                ],
+                mode="inline",
+                poll_interval=0.25,
+            )
+            d.start()
+            daemons.append(d)
+        org_ids = [o["id"] for o in orgs]
+        parity = True
+        last_trace = None
+        t_all0 = time.perf_counter()
+        for i in range(n_tasks):
+            targets = [org_ids[(i + k) % n_daemons] for k in range(2)]
+            t = client.task.create(
+                collaboration=collab["id"],
+                organizations=targets,
+                image=image,
+                input_={"method": "partial_average",
+                        "kwargs": {"column": "age"}},
+            )
+            res = client.wait_for_results(
+                t["id"], interval=0.25, timeout=120.0
+            )
+            ctx = client.trace_context(t["id"])
+            with TRACER.span(
+                "aggregate", kind="aggregate", service="client",
+                parent=ctx, require_parent=True,
+            ):
+                total = sum(r["sum"] for r in res)
+                count = sum(r["count"] for r in res)
+                parity &= count == 64 and total > 0
+            runs = client.run.from_task(t["id"])
+            parity &= sorted(
+                r["organization"]["id"] for r in runs
+            ) == sorted(targets)
+            parity &= all(
+                TaskStatus(r["status"]) == TaskStatus.COMPLETED
+                for r in runs
+            )
+            if ctx is not None:
+                last_trace = ctx.trace_id
+        total_s = time.perf_counter() - t_all0
+        out = {
+            "tasks_per_sec": round(n_tasks / total_s, 3),
+            "parity_ok": bool(parity),
+        }
+        if tracing_on and last_trace is not None:
+            spans = TRACER.drain(last_trace)
+            names = {s["name"] for s in spans}
+            required = {
+                "client.task_create", "server.dispatch", "daemon.claim",
+                "daemon.exec", "runner.exec", "daemon.report",
+                "client.wait_results", "aggregate",
+            }
+            perfetto = to_trace_events(spans)
+            x_events = [
+                e for e in perfetto["traceEvents"] if e.get("ph") == "X"
+            ]
+            metrics_text = client.util.metrics()
+            out.update({
+                "trace_id": last_trace,
+                "n_spans": len(spans),
+                "span_coverage_ok": required.issubset(names),
+                "missing_spans": sorted(required - names),
+                "perfetto_ok": bool(x_events) and all(
+                    "ts" in e and "dur" in e and "pid" in e
+                    for e in x_events
+                ),
+                "per_hop": {
+                    k: v for k, v in summarize(spans)["spans"].items()
+                    if not k.startswith(("http ", "rest "))
+                },
+                "metrics_ok": all(
+                    s in metrics_text
+                    for s in (
+                        "v6t_wire_encode_bytes_total",
+                        "v6t_rest_calls_total",
+                        "v6t_executor_inflight_items",
+                        "v6t_event_hub_buffer_len",
+                        "v6t_auth_cache_hits_total",
+                    )
+                ),
+            })
+        for d in daemons:
+            d.stop()
+        http.stop()
+        srv.close()
+        return out
+
+    try:
+        offs, ons = [], []
+        traced: dict = {}
+        for rep in range(max(1, int(os.environ.get(
+            "BENCH_OBS_REPS", str(OBS_REPS)
+        )))):
+            offs.append(arm(False, f"off{rep}"))
+            on = arm(True, f"on{rep}")
+            traced = on  # keep the freshest traced-arm evidence
+            ons.append(on)
+    finally:
+        TRACER.configure(enabled=True, sample=1.0)
+    best_off = max(a["tasks_per_sec"] for a in offs)
+    best_on = max(a["tasks_per_sec"] for a in ons)
+    overhead_pct = round(100.0 * (best_off - best_on) / best_off, 2)
+    print(json.dumps({
+        "n_daemons": n_daemons,
+        "n_tasks": n_tasks,
+        "reps": len(offs),
+        "tasks_per_sec_tracing_off": best_off,
+        "tasks_per_sec_tracing_on": best_on,
+        "overhead_pct": overhead_pct,
+        "overhead_ok": overhead_pct < OBS_OVERHEAD_PCT,
+        "overhead_budget_pct": OBS_OVERHEAD_PCT,
+        "parity_ok": all(
+            a["parity_ok"] for a in offs + ons
+        ),
+        "trace": {
+            k: traced.get(k)
+            for k in (
+                "trace_id", "n_spans", "span_coverage_ok",
+                "missing_spans", "perfetto_ok", "metrics_ok", "per_hop",
+            )
+        },
+    }))
+
+
 def worker_wireformat() -> None:
     """wire_format leg: v1 (JSON + base64 .npy) vs v2 (framed binary) wire.
 
@@ -1541,6 +1745,23 @@ def main() -> None:
     legs_done.append(leg_marker("control_plane", cp, cp_diag))
     emit()
 
+    # ---- observability guardrail (tracing on vs off) -------------------
+    # CPU by design: pure control-plane latency again, now with the span
+    # instrumentation armed — the leg exists to keep tracing overhead
+    # under OBS_OVERHEAD_PCT and to regression-test the end-to-end trace.
+    obs, obs_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        obs, obs_diag = _run_worker(
+            "observability", force_cpu=True,
+            timeout_s=leg_timeout(OBS_TIMEOUT_S),
+        )
+    if obs is not None:
+        out["observability"] = obs
+    else:
+        out["observability_error"] = obs_diag
+    legs_done.append(leg_marker("observability", obs, obs_diag))
+    emit()
+
     # ---- wire format v1 vs v2 (binary payload path PR) ----------------
     # CPU by design: (de)serialization + AES are host-side costs; keeps the
     # leg off a possibly wedged TPU tunnel entirely.
@@ -1696,6 +1917,7 @@ if __name__ == "__main__":
          "baseline": worker_baseline,
          "hostparallel": worker_hostparallel,
          "controlplane": worker_controlplane,
+         "observability": worker_observability,
          "wireformat": worker_wireformat,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()

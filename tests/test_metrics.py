@@ -1,0 +1,207 @@
+"""Direct tests for runtime/metrics.py — the module every bench leg
+depends on, previously exercised only through its consumers.
+
+Covers, per ISSUE 5's satellites:
+- MetricsLogger resource handling: context manager, double-close,
+  log-after-close tolerance;
+- round_timer record fields;
+- run_lifecycle edge cases (missing queued_at falls back to assigned_at,
+  BOTH missing doesn't raise, unstarted runs report no timings);
+- round_decomposition reporting runs that never started as
+  n_runs_untimed instead of silently dropping them;
+- wire_totals with no sized runs;
+- read_jsonl on blank and partial (torn-write) lines.
+"""
+import json
+
+import pytest
+
+from vantage6_tpu.runtime.metrics import (
+    MetricsLogger,
+    read_jsonl,
+    round_decomposition,
+    run_lifecycle,
+    wire_totals,
+)
+from vantage6_tpu.runtime.task import new_run
+
+
+def make_run(**kw):
+    defaults = dict(task_id=1, organization="org", station_index=0)
+    defaults.update(kw)
+    return new_run(**defaults)
+
+
+# ------------------------------------------------------------ MetricsLogger
+class TestMetricsLogger:
+    def test_context_manager_closes(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        with MetricsLogger(path) as ml:
+            ml.log("evt", x=1)
+        assert ml._closed
+        recs = read_jsonl(path)
+        assert len(recs) == 1 and recs[0]["event"] == "evt"
+
+    def test_double_close_is_noop(self, tmp_path):
+        ml = MetricsLogger(tmp_path / "m.jsonl")
+        ml.close()
+        ml.close()  # must not raise
+
+    def test_log_after_close_tolerated_and_counted(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        ml = MetricsLogger(path)
+        ml.log("kept")
+        ml.close()
+        ml.log("dropped")  # a late worker thread must not crash
+        ml.log("dropped2")
+        assert ml.dropped_after_close == 2
+        assert [r["event"] for r in read_jsonl(path)] == ["kept"]
+
+    def test_round_timer_fields(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        with MetricsLogger(path) as ml:
+            with ml.round_timer(3):
+                pass
+        (rec,) = read_jsonl(path)
+        assert rec["event"] == "round"
+        assert rec["round"] == 3
+        assert rec["seconds"] >= 0.0
+        # rounds_per_sec is 1/seconds (or None for a zero-length round)
+        if rec["seconds"] > 0:
+            assert rec["rounds_per_sec"] == pytest.approx(
+                1.0 / rec["seconds"]
+            )
+
+    def test_exception_in_round_timer_does_not_log(self, tmp_path):
+        # the timer yields without try/finally by design: a crashed round
+        # writes no record — pin that contract
+        path = tmp_path / "m.jsonl"
+        with MetricsLogger(path) as ml:
+            with pytest.raises(RuntimeError):
+                with ml.round_timer(0):
+                    raise RuntimeError("boom")
+        assert read_jsonl(path) == []
+
+
+# ------------------------------------------------------------ run_lifecycle
+class TestRunLifecycle:
+    def test_full_lifecycle(self):
+        r = make_run()
+        r.queued_at = 10.0
+        r.assigned_at = 9.0
+        r.started_at = 12.0
+        r.finished_at = 15.0
+        out = run_lifecycle(r)
+        assert out["queue_wait_s"] == pytest.approx(2.0)
+        assert out["exec_s"] == pytest.approx(3.0)
+        assert out["dispatch_latency_s"] == pytest.approx(3.0)
+
+    def test_missing_queued_at_falls_back_to_assigned(self):
+        r = make_run()
+        r.queued_at = None
+        r.assigned_at = 10.0
+        r.started_at = 11.5
+        r.finished_at = 12.0
+        out = run_lifecycle(r)
+        assert out["queue_wait_s"] == pytest.approx(1.5)
+
+    def test_missing_queued_and_assigned_does_not_raise(self):
+        r = make_run()
+        r.queued_at = None
+        r.assigned_at = None
+        r.started_at = 11.0
+        r.finished_at = 12.0
+        out = run_lifecycle(r)
+        assert "queue_wait_s" not in out
+        assert out["exec_s"] == pytest.approx(1.0)
+        assert "dispatch_latency_s" not in out
+
+    def test_unstarted_run_reports_no_timings(self):
+        r = make_run()  # PENDING forever (offline station)
+        out = run_lifecycle(r)
+        assert "queue_wait_s" not in out
+        assert "exec_s" not in out
+        assert out["status"] == "pending"
+
+    def test_wire_bytes_included_when_measured(self):
+        r = make_run()
+        r.input_wire_bytes = 123
+        r.result_wire_bytes = 456
+        out = run_lifecycle(r)
+        assert out["input_wire_bytes"] == 123
+        assert out["result_wire_bytes"] == 456
+
+
+# ----------------------------------------------------- round_decomposition
+class TestRoundDecomposition:
+    def test_untimed_runs_are_reported_not_dropped(self):
+        timed = make_run(station_index=0)
+        timed.started_at, timed.finished_at = 1.0, 3.0
+        never_started = make_run(station_index=1)  # killed while queued
+        offline = make_run(station_index=2)        # offline station
+        out = round_decomposition([timed, never_started, offline])
+        assert out["n_runs_timed"] == 1
+        assert out["n_runs_untimed"] == 2
+        assert out["untimed_stations"] == [1, 2]
+        assert out["straggler_station"] == 0
+
+    def test_all_untimed(self):
+        runs = [make_run(station_index=i) for i in range(3)]
+        out = round_decomposition(runs)
+        assert out == {
+            "n_runs_timed": 0,
+            "n_runs_untimed": 3,
+            "untimed_stations": [0, 1, 2],
+        }
+
+    def test_decomposition_math(self):
+        a = make_run(station_index=0)
+        a.started_at, a.finished_at = 0.0, 2.0
+        b = make_run(station_index=1)
+        b.started_at, b.finished_at = 1.0, 5.0
+        out = round_decomposition([a, b])
+        assert out["sum_exec_s"] == pytest.approx(6.0)
+        assert out["max_exec_s"] == pytest.approx(4.0)
+        assert out["span_s"] == pytest.approx(5.0)
+        assert out["straggler_station"] == 1
+        assert out["parallel_speedup_bound"] == pytest.approx(1.5)
+        assert out["n_runs_untimed"] == 0
+
+
+# ---------------------------------------------------------------- wire etc
+class TestWireTotals:
+    def test_no_sized_runs(self):
+        runs = [make_run() for _ in range(2)]  # no wire bytes measured
+        out = wire_totals(runs)
+        assert out["wire_bytes_out"] is None
+        assert out["wire_bytes_in"] is None
+        assert out["n_runs_sized"] == 0
+        assert "encode_calls" in out["wire_stats"]
+
+    def test_sized_runs_sum(self):
+        a, b = make_run(), make_run()
+        a.input_wire_bytes, a.result_wire_bytes = 100, 10
+        b.input_wire_bytes, b.result_wire_bytes = 200, 20
+        out = wire_totals([a, b])
+        assert out["wire_bytes_out"] == 300
+        assert out["wire_bytes_in"] == 30
+        assert out["n_runs_sized"] == 2
+
+
+class TestReadJsonl:
+    def test_blank_and_partial_lines_skipped(self, tmp_path):
+        path = tmp_path / "torn.jsonl"
+        path.write_text(
+            json.dumps({"event": "a"}) + "\n"
+            + "\n"                              # blank
+            + "   \n"                           # whitespace only
+            + json.dumps({"event": "b"}) + "\n"
+            + '{"event": "torn", "x": 1'        # killed mid-write
+        )
+        recs = read_jsonl(path)
+        assert [r["event"] for r in recs] == ["a", "b"]
+
+    def test_empty_file(self, tmp_path):
+        path = tmp_path / "empty.jsonl"
+        path.write_text("")
+        assert read_jsonl(path) == []

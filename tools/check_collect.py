@@ -15,19 +15,25 @@ cross-version peers depend on these formats decoding forever; a change to
 `common.serialization` that stops round-tripping either one is a
 wire-compat regression and fails here before any test runs.
 
-It ALSO audits the control-plane fast-path ROUTES: the batched endpoints
-and long-poll event channel (`run/claim-batch`, `run/batch`, `event`) must
-exist in `server/resources.py`'s route table AND still be referenced by the
+It ALSO audits the control-plane fast-path ROUTES: the batched endpoints,
+long-poll event channel and observability pair (`run/claim-batch`,
+`run/batch`, `event`, `health`, `metrics`) must exist in
+`server/resources.py`'s route table AND still be referenced by the
 daemon/client call sites that depend on them. A rename on either side
 silently degrades every "new" daemon to the per-run fallback forever — this
 gate turns that silent drift into a loud failure before any test runs.
 
+It ALSO audits the TELEMETRY registry's declared metric surface
+(`common/telemetry.py` KNOWN_METRICS): every name unique, snake_case, and
+typed — a duplicate would silently shadow a series in `GET /api/metrics`.
+
 Usage:
     python tools/check_collect.py [pytest target, default: tests/]
 
-Exit codes: 0 = clean collection + wire compat + route audit; 1 = collection
-errors, a golden blob stopped decoding, or a batched route drifted (details
-printed); 2 = pytest itself could not run.
+Exit codes: 0 = clean collection + wire compat + route audit + telemetry
+audit; 1 = collection errors, a golden blob stopped decoding, a route
+drifted, or a metric name failed the audit (details printed); 2 = pytest
+itself could not run.
 """
 from __future__ import annotations
 
@@ -49,6 +55,14 @@ _ROUTE_AUDIT: dict[str, list[str]] = {
         "vantage6_tpu/common/rest.py",      # await_task_finished long-poll
         "vantage6_tpu/node/proxy.py",       # event relay for containers
     ],
+    # observability pair (docs/observability.md): health is the daemon's
+    # ws-discovery probe AND the client util surface; metrics is the
+    # Prometheus scrape the client util exposes
+    "health": [
+        "vantage6_tpu/node/daemon.py",
+        "vantage6_tpu/client/client.py",
+    ],
+    "metrics": ["vantage6_tpu/client/client.py"],
 }
 
 
@@ -84,6 +98,46 @@ def check_control_plane_routes() -> list[str]:
                     "either the fast path was removed (update this audit) "
                     "or the call site drifted from the route name"
                 )
+    return problems
+
+
+def check_telemetry_metrics() -> list[str]:
+    """Audit the declared telemetry surface (common.telemetry
+    KNOWN_METRICS): every metric name unique, snake_case, and carrying a
+    kind + help string. A duplicate or camelCase name would silently
+    shadow a series in /metrics or break Prometheus scrapers — loud
+    failure here, before any test runs."""
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import (
+            KNOWN_METRICS,
+            validate_metric_name,
+        )
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import telemetry registry: {e!r}"]
+    seen: set[str] = set()
+    kinds = {"counter", "gauge", "histogram"}
+    for entry in KNOWN_METRICS:
+        if len(entry) != 3:
+            problems.append(f"malformed KNOWN_METRICS entry: {entry!r}")
+            continue
+        name, kind, help_ = entry
+        if name in seen:
+            problems.append(f"duplicate metric name {name!r}")
+        seen.add(name)
+        try:
+            validate_metric_name(name)
+        except ValueError as e:
+            problems.append(str(e))
+        if kind not in kinds:
+            problems.append(
+                f"metric {name!r} has unknown kind {kind!r} "
+                f"(expected one of {sorted(kinds)})"
+            )
+        if not help_:
+            problems.append(f"metric {name!r} has no help string")
     return problems
 
 
@@ -159,6 +213,16 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    telemetry_problems = check_telemetry_metrics()
+    if telemetry_problems:
+        sys.stderr.write(
+            "TELEMETRY REGISTRY BROKEN: declared metric names fail the "
+            "uniqueness/snake_case audit (docs/observability.md):\n"
+        )
+        for p in telemetry_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     target = argv[1:] or ["tests/"]
     cmd = [
         sys.executable, "-m", "pytest", *target,
@@ -194,8 +258,9 @@ def main(argv: list[str]) -> int:
         tests = re.findall(r"^(\d+) tests? collected", out, re.M)
         counted = tests[-1] if tests else "all"
         print("wire compat ok: golden v1+v2 blobs round-trip")
-        print("route audit ok: batched control-plane endpoints match "
-              "their call sites")
+        print("route audit ok: batched control-plane + observability "
+              "endpoints match their call sites")
+        print("telemetry audit ok: metric names unique and snake_case")
         print(f"collection clean: {counted} tests collected")
         return 0
     if n_errors == 0:

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Summarize a distributed-trace span file into a per-hop latency table.
+
+Input: one or more JSONL span files (the `V6T_TRACE_FILE` sink of
+`vantage6_tpu.runtime.tracing` — each process of a real deployment writes
+its own; pass them all and the traces merge by trace_id). Output: a
+per-span-name count/p50/p95/max/total table, a straggler-station
+call-out (which station's exec spans cost the most total time), and
+optionally a Chrome/Perfetto `trace_event` JSON export so the whole
+federated round renders as one timeline in ui.perfetto.dev.
+
+Usage:
+    python tools/trace_view.py trace.jsonl [more.jsonl ...]
+        [--trace TRACE_ID]     only this trace
+        [--export OUT.json]    write Perfetto trace_event JSON
+        [--json]               machine-readable summary instead of a table
+
+Exit codes: 0 = summarized; 1 = no spans found (empty/missing files or a
+--trace filter matching nothing).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from vantage6_tpu.runtime.tracing import (  # noqa: E402
+    read_spans,
+    summarize,
+    to_trace_events,
+)
+
+
+def render_table(summary: dict) -> str:
+    lines = [
+        f"{summary['n_spans']} spans across {summary['n_traces']} trace(s)"
+        + (f", {summary['n_errors']} error(s)" if summary["n_errors"] else ""),
+        "",
+        f"{'span':<28} {'count':>6} {'p50 ms':>10} {'p95 ms':>10} "
+        f"{'max ms':>10} {'total ms':>10}",
+        "-" * 78,
+    ]
+    for name, row in summary["spans"].items():
+        lines.append(
+            f"{name:<28} {row['count']:>6} {row['p50_ms']:>10.3f} "
+            f"{row['p95_ms']:>10.3f} {row['max_ms']:>10.3f} "
+            f"{row['total_ms']:>10.3f}"
+        )
+    straggler = summary.get("straggler")
+    if straggler:
+        lines += [
+            "",
+            f"straggler station: {straggler['station']} "
+            f"({straggler['exec_total_ms']:.3f} ms total exec)",
+        ]
+        per = straggler.get("per_station_exec_ms") or {}
+        if len(per) > 1:
+            lines.append("per-station exec totals:")
+            for station, ms in sorted(
+                per.items(), key=lambda kv: -kv[1]
+            ):
+                lines.append(f"  station {station:<12} {ms:>10.3f} ms")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="JSONL span file(s)")
+    ap.add_argument("--trace", help="restrict to one trace_id")
+    ap.add_argument("--export", help="write Perfetto trace_event JSON here")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the summary as JSON instead of a table",
+    )
+    args = ap.parse_args(argv)
+
+    spans: list[dict] = []
+    for path in args.files:
+        try:
+            spans.extend(read_spans(path))
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+    if args.trace:
+        spans = [s for s in spans if s.get("trace_id") == args.trace]
+    if not spans:
+        print("no spans found", file=sys.stderr)
+        return 1
+
+    if args.export:
+        with open(args.export, "w") as fh:
+            json.dump(to_trace_events(spans), fh)
+        print(
+            f"wrote {args.export} "
+            "(load in ui.perfetto.dev or chrome://tracing)",
+            file=sys.stderr,
+        )
+
+    summary = summarize(spans)
+    print(
+        json.dumps(summary, indent=2) if args.json
+        else render_table(summary)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -109,8 +109,21 @@ def wrap_algorithm(module: ModuleType | str | None = None) -> None:
     )
     args = payload.get("args", []) or []
     kwargs = payload.get("kwargs", {}) or {}
-    with algorithm_environment(env):
-        result = fn(*args, **kwargs)
+    # distributed tracing across the container ABI: the node's TaskRunner
+    # forwards the run's trace context as V6T_TRACEPARENT; executing under
+    # a joined span gives THIS process a current context, so every REST
+    # hop the algorithm makes (subtask fan-out through the proxy) carries
+    # the task's trace onward — nested central→partial rounds stay ONE
+    # trace even in sandbox mode. No-op when untraced.
+    from vantage6_tpu.runtime.tracing import TRACER
+
+    with TRACER.span(
+        "algorithm.run", kind="algorithm",
+        parent=os.environ.get("V6T_TRACEPARENT"),
+        attrs={"method": method}, require_parent=True,
+    ):
+        with algorithm_environment(env):
+            result = fn(*args, **kwargs)
     with open(output_path, "wb") as f:
         f.write(serialize(result))
 

@@ -17,6 +17,7 @@ from vantage6_tpu.common.encryption import CryptorBase, DummyCryptor, RSACryptor
 from vantage6_tpu.common.log import setup_logging
 from vantage6_tpu.common.rest import RestError, RestSession
 from vantage6_tpu.common.serialization import deserialize, serialize
+from vantage6_tpu.runtime.tracing import TRACER
 
 log = setup_logging("vantage6_tpu/client")
 
@@ -45,6 +46,11 @@ class UserClient:
         # event long-poll capability (None until probed; see
         # common.rest.await_task_finished)
         self._event_push: bool | None = None
+        # task_id -> SpanContext of the client-side root span that created
+        # it: wait_for_results and caller-side aggregation spans attach
+        # here so a whole federated round stays ONE trace. Bounded FIFO —
+        # a long-lived client must not grow it forever.
+        self._task_traces: dict[int, Any] = {}
         self._rest = RestSession(
             self.base_url,
             token_getter=lambda: self._access_token,
@@ -73,9 +79,10 @@ class UserClient:
         json_body: Any = None,
         params: dict[str, Any] | None = None,
         timeout: float | None = None,
+        raw: bool = False,
     ) -> Any:
         return self._rest.request(
-            method, endpoint, json_body, params, timeout=timeout
+            method, endpoint, json_body, params, timeout=timeout, raw=raw
         )
 
     def paginate(
@@ -149,6 +156,24 @@ class UserClient:
                     {"public_key": self.cryptor.public_key_str},
                 )
 
+    # ----------------------------------------------------------- tracing
+    def trace_context(self, task_id: int) -> Any:
+        """The trace context (SpanContext) of `task.create(task_id)`, or
+        None — parent caller-side spans (e.g. an aggregation step) on it
+        so they land in the task's own trace:
+
+            with TRACER.span("aggregate", kind="aggregate",
+                             parent=client.trace_context(tid)): ...
+        """
+        return self._task_traces.get(task_id)
+
+    def _remember_trace(self, task_id: int, ctx: Any) -> None:
+        if ctx is None:
+            return
+        self._task_traces[task_id] = ctx
+        while len(self._task_traces) > 256:
+            self._task_traces.pop(next(iter(self._task_traces)))
+
     # --------------------------------------------------------------- results
     def wait_for_results(
         self, task_id: int, interval: float = 0.5, timeout: float = 300.0
@@ -165,23 +190,34 @@ class UserClient:
         """
         from vantage6_tpu.common.rest import await_task_finished
 
-        status = await_task_finished(self, task_id, interval, timeout)
-        if status.has_failed:
+        # joins the trace task.create started (no-op for untraced tasks);
+        # the decrypt+deserialize collection loop is inside the span too —
+        # that is the client-decode leg of the per-hop table
+        with TRACER.span(
+            "client.wait_results", kind="client", service="client",
+            parent=self.trace_context(task_id),
+            attrs={"task_id": task_id}, require_parent=True,
+        ):
+            status = await_task_finished(self, task_id, interval, timeout)
+            if status.has_failed:
+                runs = self.paginate(f"task/{task_id}/run")
+                logs = {r["organization"]["id"]: r["log"] for r in runs}
+                raise ClientError(
+                    500, f"task {task_id} {status.value}: {logs}"
+                )
             runs = self.paginate(f"task/{task_id}/run")
-            logs = {r["organization"]["id"]: r["log"] for r in runs}
-            raise ClientError(500, f"task {task_id} {status.value}: {logs}")
-        runs = self.paginate(f"task/{task_id}/run")
-        out = []
-        for run in sorted(runs, key=lambda r: r["id"]):
-            blob = run.get("result")
-            if not blob:
-                out.append(None)
-                continue
-            # writable: researchers get arrays they can mutate (v1 parity)
-            out.append(deserialize(
-                self.cryptor.decrypt_str_to_bytes(blob), writable=True
-            ))
-        return out
+            out = []
+            for run in sorted(runs, key=lambda r: r["id"]):
+                blob = run.get("result")
+                if not blob:
+                    out.append(None)
+                    continue
+                # writable: researchers get arrays they can mutate
+                # (v1 parity)
+                out.append(deserialize(
+                    self.cryptor.decrypt_str_to_bytes(blob), writable=True
+                ))
+            return out
 
 
 class SubClient:
@@ -238,6 +274,44 @@ class TaskSubClient(SubClient):
         node executes the SAME run as one collective SPMD program over the
         federation's global device mesh (the nodes must be configured with
         ``device_engine`` so their daemons joined the mesh at start)."""
+        # ROOT span of the task's distributed trace: encode+encrypt+POST
+        # here, server dispatch / daemon claim+exec / result upload attach
+        # underneath via the traceparent the POST carries (tracing.py)
+        with TRACER.span(
+            "client.task_create", kind="client", service="client",
+            attrs={"image": image, "n_orgs": len(organizations)},
+        ) as span:
+            task = self._create_traced(
+                collaboration=collaboration,
+                organizations=organizations,
+                name=name,
+                image=image,
+                description=description,
+                input_=input_,
+                databases=databases,
+                study=study,
+                session=session,
+                store_as=store_as,
+                engine=engine,
+            )
+            span.set_attr(task_id=task.get("id"))
+            self.parent._remember_trace(task.get("id"), span.context)
+            return task
+
+    def _create_traced(
+        self,
+        collaboration: int,
+        organizations: list[int],
+        name: str,
+        image: str,
+        description: str,
+        input_: dict[str, Any] | None,
+        databases: list[dict[str, Any]] | None,
+        study: int | None,
+        session: int | None,
+        store_as: str | None,
+        engine: str | None,
+    ) -> dict[str, Any]:
         input_ = input_ or {}
         blob = serialize(input_)
         # the COLLABORATION decides whether payloads are encrypted (the
@@ -352,6 +426,11 @@ class UtilSubClient:
 
     def health(self) -> dict[str, Any]:
         return self.parent.request("GET", "health")
+
+    def metrics(self) -> str:
+        """The server's unified telemetry as Prometheus text (wire, REST,
+        HTTP, executor, event-hub, cache and tracing series)."""
+        return self.parent.request("GET", "metrics", raw=True)
 
     def version(self) -> dict[str, Any]:
         return self.parent.request("GET", "version")

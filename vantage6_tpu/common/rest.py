@@ -24,12 +24,18 @@ scope the counters to one round or bench arm.
 """
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Any, Callable
 from urllib.parse import urlsplit
 
 import requests
+
+from vantage6_tpu.runtime.tracing import TRACER
+
+# low-cardinality span names: /api/run/17 and /api/run/99 are the same hop
+_ID_SEGMENT = re.compile(r"/\d+")
 
 
 class RestError(RuntimeError):
@@ -154,7 +160,49 @@ def pooled_request(
     PROCESSED (ECONNRESET after commit is indistinguishable from a stale
     socket), and a silent re-send would duplicate the side effect — e.g.
     create a task fan-out twice.
+
+    Tracing: when the calling thread is inside a sampled trace, the
+    request carries a `traceparent` header (the server joins the trace)
+    and the hop itself is recorded as a `rest` span — that is the
+    client-encode→REST-hop attribution of docs/observability.md. Outside
+    a trace this adds one thread-local read and nothing else.
     """
+    ctx = TRACER.current_context()
+    if ctx is not None:
+        if ctx.sampled:
+            path = _ID_SEGMENT.sub("/<id>", urlsplit(url).path)
+            with TRACER.span(
+                f"rest {method.upper()} {path}", kind="rest",
+                attrs={"url_path": path},
+            ):
+                # inject INSIDE the span: the server's handler span must
+                # parent on this REST hop (hop minus nested server span =
+                # network/transport overhead), not on the outer caller
+                hdrs = dict(headers or {})
+                hdrs.setdefault(
+                    "traceparent", TRACER.current_traceparent()
+                )
+                return _pooled_request_impl(
+                    method, url, json_body=json_body, params=params,
+                    headers=hdrs, timeout=timeout,
+                )
+        headers = dict(headers or {})
+        headers.setdefault("traceparent", ctx.to_traceparent())
+    return _pooled_request_impl(
+        method, url, json_body=json_body, params=params,
+        headers=headers, timeout=timeout,
+    )
+
+
+def _pooled_request_impl(
+    method: str,
+    url: str,
+    *,
+    json_body: Any = None,
+    params: dict[str, Any] | None = None,
+    headers: dict[str, str] | None = None,
+    timeout: float | None = None,
+) -> requests.Response:
     t0 = time.perf_counter()
     stale_retry = False
     session = POOL.acquire(url)
@@ -219,7 +267,11 @@ class RestSession:
         params: dict[str, Any] | None = None,
         _retry: bool = True,
         timeout: float | None = None,
+        raw: bool = False,
     ) -> Any:
+        """JSON request/response; ``raw=True`` returns the response body
+        as text instead (non-JSON endpoints: /api/metrics Prometheus
+        exposition)."""
         headers = {}
         token = self._token_getter()
         if token:
@@ -239,8 +291,12 @@ class RestSession:
             and self._refresh()
         ):
             return self.request(
-                method, endpoint, json_body, params, False, timeout
+                method, endpoint, json_body, params, False, timeout, raw
             )
+        if raw:
+            if resp.status_code >= 400:
+                raise RestError(resp.status_code, resp.text[:200])
+            return resp.text
         body = resp.json() if resp.content else {}
         if resp.status_code >= 400:
             raise RestError(resp.status_code, body.get("msg", resp.text))

@@ -31,6 +31,7 @@ from vantage6_tpu.node.runner import (
     TaskRunner,
     UnknownAlgorithm,
 )
+from vantage6_tpu.runtime.tracing import TRACER, parse_traceparent
 
 log = setup_logging("vantage6_tpu/node")
 
@@ -397,6 +398,7 @@ class NodeDaemon:
                 body["exclude_run_ids"] = sorted(self._claimed)
         if reset_orphans:
             body["reset_orphans"] = True
+        t_wall, t_perf = time.time(), time.perf_counter()
         try:
             resp = self.request("POST", "run/claim-batch", body)
         except RestError as e:
@@ -406,7 +408,17 @@ class NodeDaemon:
                 return None
             raise
         self._batch_ok = True
-        return resp.get("data", [])
+        entries = resp.get("data", [])
+        # claim attribution for SWEEP-prefetched runs: the batch round-trip
+        # IS their claim window — stash it so _execute can record a
+        # daemon.claim span even though it never fetches (sweep-claimed
+        # runs — offline daemon, lost event — are precisely the
+        # slow-dispatch cases the trace exists to explain)
+        claim_s = time.perf_counter() - t_perf
+        for entry in entries:
+            entry["_claim_wall0"] = t_wall
+            entry["_claim_s"] = claim_s
+        return entries
 
     def _report(self, run_id: int, **fields: Any) -> None:
         """Report run status/result — batched (coalescing reporter) when
@@ -1028,6 +1040,12 @@ class NodeDaemon:
         with self._claim_lock:
             pre = self._prefetched.pop(run_id, None)
         prefetched_token: str | None = None
+        # claim attribution: when THIS call pays the fetch round-trip(s)
+        # (event dispatch / per-run path — a sweep-prefetched entry already
+        # paid inside claim-batch), measure it and record a retroactive
+        # daemon.claim span once the task's trace context is known
+        claim_wall0, claim_perf0 = time.time(), time.perf_counter()
+        fetched_here = pre is None
         if pre is None and self.transport == "batched" \
                 and self._batch_ok is not False:
             # event-dispatch fast path: run + task + container token in ONE
@@ -1074,7 +1092,42 @@ class NodeDaemon:
                     self._prefetched[run_id] = pre
             self._device_queue.put((task["id"], run_id))
             return
+        # one federated task = ONE trace: the server persisted the creating
+        # request's context on the task; every span below attaches to it.
+        # Untraced tasks (old server, tracing off) resolve to None and the
+        # spans are no-ops — require_parent keeps polling noise out.
+        tctx = parse_traceparent(task.get("traceparent"))
+        service = f"daemon:{self.name}"
+        trace_attrs = {
+            "run_id": run_id, "task_id": task.get("id"),
+            "node_id": self.id, "organization_id": self.organization_id,
+        }
+        if tctx is not None:
+            if fetched_here:
+                wall0: float | None = claim_wall0
+                claim_s = time.perf_counter() - claim_perf0
+            else:  # sweep-prefetched: use the batch round-trip's window
+                wall0 = pre.get("_claim_wall0")
+                claim_s = pre.get("_claim_s", 0.0)
+            if wall0 is not None:
+                TRACER.record_span(
+                    "daemon.claim", wall0, claim_s,
+                    parent=tctx, kind="claim", service=service,
+                    attrs=trace_attrs,
+                )
+        with TRACER.span(
+            "daemon.exec", kind="daemon", parent=tctx, service=service,
+            attrs=trace_attrs, require_parent=True,
+        ):
+            self._execute_run(run_id, run, task, prefetched_token)
 
+    def _execute_run(
+        self,
+        run_id: int,
+        run: dict[str, Any],
+        task: dict[str, Any],
+        prefetched_token: str | None,
+    ) -> None:
         def patch(**kw: Any) -> None:
             try:
                 self._report(run_id, **kw)
@@ -1169,7 +1222,19 @@ class NodeDaemon:
             )
             if spec.engine == "device" and self.runner.device_engine:
                 self._await_device_peers(task, run_id)
-            result = self.runner.run(spec)
+            # kind="exec" is what the straggler view groups by station
+            with TRACER.span(
+                "runner.exec", kind="exec",
+                service=f"daemon:{self.name}",
+                attrs={
+                    "run_id": run_id,
+                    "organization_id": self.organization_id,
+                    "node_id": self.id,
+                    "engine": spec.engine,
+                },
+                require_parent=True,
+            ):
+                result = self.runner.run(spec)
         except PolicyViolation as e:
             patch(
                 status=TaskStatus.NOT_ALLOWED.value,
@@ -1217,11 +1282,20 @@ class NodeDaemon:
                 pubkey,
                 format=wire_format,
             )
-            patch(
-                status=TaskStatus.COMPLETED.value,
-                result=blob,
-                finished_at=time.time(),
-            )
+            # result upload as its own hop: serialize+encrypt above stay in
+            # daemon.exec; this span is PURELY the report round-trip (which
+            # may coalesce into a PATCH run/batch — the wait is the cost)
+            with TRACER.span(
+                "daemon.report", kind="report",
+                service=f"daemon:{self.name}",
+                attrs={"run_id": run_id, "result_bytes": len(blob)},
+                require_parent=True,
+            ):
+                patch(
+                    status=TaskStatus.COMPLETED.value,
+                    result=blob,
+                    finished_at=time.time(),
+                )
         except Exception:
             patch(
                 status=TaskStatus.FAILED.value,

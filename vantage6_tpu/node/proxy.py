@@ -175,7 +175,8 @@ class NodeProxy:
         def organizations(req: Request):
             return self._forward(req, "GET", "organization")
 
-        @app.route("/api/event", methods=("GET",))
+        # untimed: relayed long polls block for the upstream wait window
+        @app.route("/api/event", methods=("GET",), untimed=True)
         def events(req: Request):
             # event long-poll relay: a central algorithm's
             # wait_for_results blocks HERE (query params — since/wait —
@@ -185,6 +186,25 @@ class NodeProxy:
         @app.route("/api/health", methods=("GET",))
         def health(req: Request):
             return {"status": "ok", "proxy": True}
+
+        @app.route("/api/metrics", methods=("GET",))
+        def metrics(req: Request):
+            """NODE-process telemetry (Prometheus text): the daemon's
+            wire/REST/tracing counters live in this process, not the
+            server's — operators scrape each node here. Trace context
+            relays transparently: the container's `traceparent` header
+            joins the proxy's server span, and `pooled_request` forwards
+            the continuation upstream on every relayed call."""
+            from vantage6_tpu.common.telemetry import (
+                PROMETHEUS_CONTENT_TYPE,
+                REGISTRY,
+            )
+            from vantage6_tpu.server.web import Response
+
+            return Response(
+                REGISTRY.render_prometheus(),
+                headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+            )
 
     def _token_task_id(self, req: Request) -> int:
         """Best-effort read of the container token's task id (unverified

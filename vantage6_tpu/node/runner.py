@@ -405,6 +405,14 @@ class TaskRunner:
         if wire_format:
             # the child's OUTPUT_FILE serialize follows the same node policy
             env["V6T_WIRE_FORMAT"] = str(wire_format)
+        # trace context crosses the ABI: the subprocess executes under a
+        # span joined on this (wrap_algorithm reads it), so the child's
+        # subtask fan-out stays in the task's trace (docs/observability.md)
+        from vantage6_tpu.runtime.tracing import TRACER
+
+        traceparent = TRACER.current_traceparent()
+        if traceparent:
+            env["V6T_TRACEPARENT"] = traceparent
         if not self.policies.get("accelerator", False):
             # sandboxed algorithms default to CPU, like the reference's
             # containers: faster startup and no contention for (or hangs on)
@@ -449,18 +457,28 @@ class TaskRunner:
             elif k == "collaboration":
                 env["COLLABORATION_NAME"] = str(v)
 
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "from vantage6_tpu.algorithm.wrap import wrap_algorithm; "
-                f"wrap_algorithm({module!r})",
-            ],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=self.policies.get("task_timeout", 600),
-        )
+        # child of the daemon's runner.exec span: separates subprocess
+        # spawn+ABI overhead from the run's total (inline mode has none,
+        # which is exactly what this makes visible in the per-hop table)
+        from vantage6_tpu.runtime.tracing import TRACER
+
+        with TRACER.span(
+            "runner.sandbox", kind="sandbox",
+            attrs={"run_id": spec.run_id, "image": spec.image},
+            require_parent=True,
+        ):
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "from vantage6_tpu.algorithm.wrap import wrap_algorithm; "
+                    f"wrap_algorithm({module!r})",
+                ],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=self.policies.get("task_timeout", 600),
+            )
         (run_dir / "log").write_text(proc.stdout + proc.stderr)
         if proc.returncode != 0:
             raise RuntimeError(

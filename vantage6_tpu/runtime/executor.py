@@ -27,9 +27,18 @@ so an explicit ``timeout`` keeps its polling semantics.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
+
+# telemetry: queue-depth visibility across every live pool in the process
+# (the unified registry's executor series — docs/observability.md; the
+# collector itself is registered by common.telemetry, which imports this
+# set lazily so the series exists even before any pool does). A WeakSet
+# so abandoned pools vanish from the gauge with their GC, not at an
+# explicit close.
+_LIVE_POOLS: "weakref.WeakSet[StationExecutor]" = weakref.WeakSet()
 
 
 class StationExecutor:
@@ -59,6 +68,7 @@ class StationExecutor:
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="v6t-station"
         )
+        _LIVE_POOLS.add(self)
 
     # ----------------------------------------------------------------- submit
     def submit(self, station: int, item: Callable[[], Any]) -> None:
@@ -180,6 +190,7 @@ class StationExecutor:
     def close(self) -> None:
         """Tear down the pool. Queued-but-unstarted items are dropped —
         only for Federation teardown, never mid-protocol."""
+        _LIVE_POOLS.discard(self)  # dropped items would pin the gauge
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()

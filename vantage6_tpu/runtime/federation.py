@@ -44,6 +44,7 @@ from vantage6_tpu.core.config import DatabaseConfig, FederationConfig
 from vantage6_tpu.core.mesh import FederationMesh, Station
 from vantage6_tpu.runtime.executor import StationExecutor
 from vantage6_tpu.runtime.task import Run, Task, new_run, new_task
+from vantage6_tpu.runtime.tracing import TRACER
 
 
 class Federation:
@@ -335,7 +336,14 @@ class Federation:
             for o in orgs
         ]
         self.tasks[task.id] = task
-        self._dispatch(task)
+        # in-process analogue of the server's dispatch span: roots a new
+        # trace when the caller isn't already inside one, so a simulator
+        # round traces exactly like a daemon-topology round
+        with TRACER.span(
+            "server.dispatch", kind="dispatch", service="federation",
+            attrs={"task_id": task.id, "n_runs": len(task.runs)},
+        ):
+            self._dispatch(task)
         if wait:
             self._await_inflight(task.runs)
         return task
@@ -501,12 +509,16 @@ class Federation:
         run.mark_queued()
         with self._inflight_lock:
             self._inflight_runs.add(run.id)
+        # capture the submitter's trace context NOW: the pool worker that
+        # executes the item has no ambient span, and without this capture
+        # every pooled run would fall out of its task's trace
+        trace_parent = TRACER.current_context()
 
         def item() -> None:
             try:
                 # killed while queued: skip without ever going ACTIVE
                 if not run.status.is_finished:
-                    self._run_host(task, fn, run)
+                    self._run_host(task, fn, run, trace_parent=trace_parent)
             finally:
                 with self._inflight_lock:
                     self._inflight_runs.discard(run.id)
@@ -599,7 +611,9 @@ class Federation:
                 )
 
     # ------------------------------------------------------------- host mode
-    def _run_host(self, task: Task, fn: Callable, run: Run) -> None:
+    def _run_host(
+        self, task: Task, fn: Callable, run: Run, trace_parent: Any = None,
+    ) -> None:
         from vantage6_tpu.algorithm.client import AlgorithmClient
 
         if not run.start():
@@ -631,7 +645,22 @@ class Federation:
         args = task.input_.get("args", []) or []
         kwargs = task.input_.get("kwargs", {}) or {}
         try:
-            with algorithm_environment(env):
+            # kind="exec" feeds the straggler view; the parent is either
+            # the captured submit-time context (pooled path) or the
+            # ambient dispatch span (synchronous path)
+            with TRACER.span(
+                "runner.exec", kind="exec", service="federation",
+                parent=(
+                    trace_parent if trace_parent is not None
+                    else TRACER.current_context()
+                ),
+                attrs={
+                    "task_id": task.id, "run_id": run.id,
+                    "station": run.station_index,
+                    "organization_id": run.organization,
+                },
+                require_parent=True,
+            ), algorithm_environment(env):
                 result = fn(*args, **kwargs)
             if task.store_as:
                 result = self._store_session_result(task, run, result)
@@ -680,10 +709,21 @@ class Federation:
         for run in runnable:
             run.start()
         try:
-            stacked = self.stacked_data(label)
-            out = self.mesh.fed_map(
-                lambda d: fn(d, *args, **kwargs), stacked
-            )
+            # ONE span for the collective program (all stations execute it
+            # together — a per-station split would be fiction); joins the
+            # ambient dispatch span so device rounds trace like host rounds
+            with TRACER.span(
+                "device.step", kind="exec", service="federation",
+                attrs={
+                    "task_id": task.id,
+                    "n_stations": len(runnable),
+                },
+                require_parent=True,
+            ):
+                stacked = self.stacked_data(label)
+                out = self.mesh.fed_map(
+                    lambda d: fn(d, *args, **kwargs), stacked
+                )
         except Exception:
             tb = traceback.format_exc(limit=8)
             for run in runnable:
@@ -735,22 +775,31 @@ class Federation:
             raise ValueError(
                 f"task {task.id} has no stacked (device-mode) result"
             )
-        if agg_mode == "replicated":
-            return fed_mean(
-                task.stacked_result, weights=weights, mask=task.participation
+        # the aggregation leg of the round's trace (no-op outside a trace)
+        with TRACER.span(
+            "aggregate", kind="aggregate", service="federation",
+            attrs={"task_id": task.id, "agg_mode": agg_mode},
+            require_parent=True,
+        ):
+            if agg_mode == "replicated":
+                return fed_mean(
+                    task.stacked_result, weights=weights,
+                    mask=task.participation,
+                )
+            if agg_mode not in ("scattered", "scattered_bf16"):
+                raise ValueError(
+                    f"unknown agg_mode {agg_mode!r} (replicated | scattered"
+                    " | scattered_bf16)"
+                )
+            return fed_mean_scattered_tree(
+                self.mesh,
+                task.stacked_result,
+                weights=weights,
+                mask=task.participation,
+                comm_dtype=(
+                    jnp.bfloat16 if agg_mode == "scattered_bf16" else None
+                ),
             )
-        if agg_mode not in ("scattered", "scattered_bf16"):
-            raise ValueError(
-                f"unknown agg_mode {agg_mode!r} (replicated | scattered | "
-                "scattered_bf16)"
-            )
-        return fed_mean_scattered_tree(
-            self.mesh,
-            task.stacked_result,
-            weights=weights,
-            mask=task.participation,
-            comm_dtype=jnp.bfloat16 if agg_mode == "scattered_bf16" else None,
-        )
 
     # ------------------------------------------------------ elastic recovery
     def _drain_pending(self, station: int) -> None:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from pathlib import Path
 from typing import Any, Iterator
@@ -17,17 +18,42 @@ import jax
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics, one object per event."""
+    """Append-only JSONL metrics, one object per event.
+
+    Resource handling: usable as a context manager, `close()` is
+    idempotent, and `log()` after close is a counted no-op instead of a
+    ValueError on the closed handle — a late-finishing worker thread
+    logging into a torn-down logger must not crash the run it outlives
+    (the dropped-event count is inspectable: `dropped_after_close`).
+    """
 
     def __init__(self, path: str | Path):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = open(self.path, "a", buffering=1)
         self._round_t0: float | None = None
+        self._closed = False
+        # the closed-check and the write must be one atomic step: the
+        # tolerated caller is a WORKER THREAD racing the owning thread's
+        # close() — an unlocked check-then-act would still crash on the
+        # just-closed handle
+        self._close_lock = threading.Lock()
+        self.dropped_after_close = 0
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
 
     def log(self, event: str, **fields: Any) -> None:
         rec = {"ts": time.time(), "event": event, **fields}
-        self._fh.write(json.dumps(rec, default=_tolerant) + "\n")
+        line = json.dumps(rec, default=_tolerant) + "\n"
+        with self._close_lock:
+            if self._closed:
+                self.dropped_after_close += 1
+                return
+            self._fh.write(line)
 
     @contextlib.contextmanager
     def round_timer(self, round_index: int) -> Iterator[None]:
@@ -44,7 +70,11 @@ class MetricsLogger:
         self.log("round", **fields)
 
     def close(self) -> None:
-        self._fh.close()
+        with self._close_lock:
+            if self._closed:
+                return  # double-close is a no-op, not an error
+            self._closed = True
+            self._fh.close()
 
 
 def run_lifecycle(run: Any) -> dict[str, Any]:
@@ -64,7 +94,11 @@ def run_lifecycle(run: Any) -> dict[str, Any]:
     }
     queued = run.queued_at if run.queued_at is not None else run.assigned_at
     if run.started_at is not None:
-        out["queue_wait_s"] = max(0.0, run.started_at - queued)
+        # a run can start with NO queue timestamp at all (synchronous
+        # dispatch predating mark_queued, or a record missing assigned_at):
+        # report what is known instead of raising on the None arithmetic
+        if queued is not None:
+            out["queue_wait_s"] = max(0.0, run.started_at - queued)
         if run.finished_at is not None:
             out["exec_s"] = run.finished_at - run.started_at
     # control-plane dispatch latency: assignment (task creation fanned the
@@ -100,14 +134,28 @@ def round_decomposition(runs: list[Any]) -> dict[str, Any]:
         for r in runs
         if r.started_at is not None and r.finished_at is not None
     ]
+    # runs that never produced a start/finish pair — killed while queued,
+    # stuck PENDING on an offline station — were previously dropped
+    # SILENTLY, making a round with missing stations look fast. Name them.
+    untimed = [
+        r.station_index
+        for r in runs
+        if r.started_at is None or r.finished_at is None
+    ]
     if not spans:
-        return {"n_runs_timed": 0}
+        return {
+            "n_runs_timed": 0,
+            "n_runs_untimed": len(untimed),
+            "untimed_stations": sorted(untimed),
+        }
     execs = [(s, t1 - t0) for s, t0, t1 in spans]
     sum_s = sum(dt for _, dt in execs)
     straggler, max_s = max(execs, key=lambda e: e[1])
     span = max(t1 for _, _, t1 in spans) - min(t0 for _, t0, _ in spans)
     return {
         "n_runs_timed": len(spans),
+        "n_runs_untimed": len(untimed),
+        "untimed_stations": sorted(untimed),
         "sum_exec_s": sum_s,
         "max_exec_s": max_s,
         "span_s": span,
@@ -188,22 +236,42 @@ def profile_trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
 
     Wrap a round or a run_rounds call; no-op when disabled so call sites can
     leave it in place unconditionally.
+
+    When the caller is inside a distributed trace (runtime.tracing), the
+    profiler session is recorded as a `device.profile` span carrying the
+    log dir — the join point between a federated round's trace and its
+    on-device XLA Perfetto session (same trace_id on both sides).
     """
     if not enabled:
         yield
         return
-    jax.profiler.start_trace(str(log_dir))
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    from vantage6_tpu.runtime.tracing import TRACER
+
+    with TRACER.span(
+        "device.profile", kind="device",
+        attrs={"log_dir": str(log_dir)}, require_parent=True,
+    ):
+        jax.profiler.start_trace(str(log_dir))
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 def read_jsonl(path: str | Path) -> list[dict[str, Any]]:
+    """Read a JSONL metrics file, skipping blank and undecodable lines.
+
+    A process killed mid-write leaves a torn final line; every bench
+    consumer of this file wants the records that DID land, not a
+    JSONDecodeError at offset N."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
     return out

@@ -56,11 +56,35 @@ class ServerApp:
         self.store_url = store_url.rstrip("/") if store_url else None
         self.ws_url: str | None = None  # set by an attached WebSocketBridge
         self._bridges: list[Any] = []  # stopped in close()
-        self.app = App("vantage6_tpu-server")
+        self.app = App("server")
+        # unified telemetry (common.telemetry): this server's hot-state
+        # gauges — event hub fill/eviction, cache hit rates — join the
+        # process-wide wire/REST/executor/tracing series behind
+        # GET /api/metrics. Keyed registration: a newer ServerApp in the
+        # same process replaces this one's collector.
+        from vantage6_tpu.common.telemetry import REGISTRY
+
+        REGISTRY.register_collector("server", self._telemetry_collector)
         register_resources(self)
         from vantage6_tpu.server.ui import register_ui
 
         register_ui(self)
+
+    def _telemetry_collector(self) -> dict[str, float]:
+        hub = self.hub.stats()
+        return {
+            "v6t_event_hub_buffer_len": hub["buffer_len"],
+            "v6t_event_hub_cursor": hub["cursor"],
+            "v6t_event_hub_evicted_through": hub["evicted_through"],
+            "v6t_event_hub_subscribers": hub["subscribers"],
+            "v6t_auth_cache_hits_total": self.auth_cache.hits,
+            "v6t_auth_cache_misses_total": self.auth_cache.misses,
+            "v6t_auth_cache_entries": len(self.auth_cache),
+            "v6t_visibility_cache_hits_total": self.vis_cache.hits,
+            "v6t_visibility_cache_misses_total": self.vis_cache.misses,
+            "v6t_visibility_cache_entries": len(self.vis_cache),
+            "v6t_server_uptime_seconds": time.time() - self.started_at,
+        }
 
     def close(self) -> None:
         """Stop attached bridges and release the database binding (required
@@ -71,6 +95,11 @@ class ServerApp:
             except Exception:  # pragma: no cover
                 pass
         self._bridges.clear()
+        # symmetric with __init__'s register: a closed server must not
+        # keep reporting (or be pinned alive by) the telemetry registry
+        from vantage6_tpu.common.telemetry import REGISTRY
+
+        REGISTRY.unregister_collector("server", self._telemetry_collector)
         self.db.close()
         models.Model.db = None
 

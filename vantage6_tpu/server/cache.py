@@ -51,6 +51,10 @@ class AuthCache:
             self.hits += 1
             return entry[1], entry[2]
 
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
     def put(
         self, token: str, kind: str, principal: Any,
         token_exp: float | None = None,
@@ -91,14 +95,28 @@ class VisibilityCache:
         self.ttl = ttl
         self._lock = threading.Lock()
         self._entries: dict[int, tuple[float, frozenset[int]]] = {}
+        # hit/miss accounting for the unified telemetry registry — the
+        # same observability the AuthCache already had
+        self.hits = 0
+        self.misses = 0
 
     def get(self, org_id: int) -> frozenset[int] | None:
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(org_id)
             if entry is None or entry[0] < now:
+                if entry is not None:
+                    # drop the expired entry NOW: a quiet org must not
+                    # keep inflating the v6t_visibility_cache_entries gauge
+                    del self._entries[org_id]
+                self.misses += 1
                 return None
+            self.hits += 1
             return entry[1]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
     def put(self, org_id: int, collab_ids: frozenset[int]) -> None:
         with self._lock:

@@ -206,3 +206,14 @@ class EventHub:
         """Sequence number of the newest event (0 when empty)."""
         with self._lock:
             return self._buffer[-1].seq if self._buffer else 0
+
+    def stats(self) -> dict[str, int]:
+        """One atomic snapshot for the telemetry registry: buffer fill,
+        cursor position, eviction watermark, subscriber count."""
+        with self._lock:
+            return {
+                "buffer_len": len(self._buffer),
+                "cursor": self._buffer[-1].seq if self._buffer else 0,
+                "evicted_through": self._evicted_through,
+                "subscribers": len(self._subs),
+            }

@@ -110,6 +110,16 @@ def _m5_dispatch_indexes(db: Database) -> None:
     )
 
 
+def _m6_trace_metadata(db: Database) -> None:
+    """v6: distributed-tracing task metadata. The columns themselves
+    (task.trace_id, task.traceparent) arrive via additive DDL; this adds
+    the lookup index so "every task of trace X" — the trace_view /
+    observability join — is not a table scan on a busy server."""
+    db.execute(
+        "CREATE INDEX IF NOT EXISTS idx_task_trace_id ON task(trace_id)"
+    )
+
+
 MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
     (1, "baseline schema", _m1_baseline),
     (2, "unique index on user.username (+dedupe)", _m2_unique_username),
@@ -118,6 +128,7 @@ MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
      _m4_hot_query_indexes),
     (5, "dispatch-path indexes: run(org,status), run(node,status)",
      _m5_dispatch_indexes),
+    (6, "tracing metadata index: task(trace_id)", _m6_trace_metadata),
 ]
 
 SCHEMA_VERSION = MIGRATIONS[-1][0]

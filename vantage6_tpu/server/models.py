@@ -305,6 +305,12 @@ class Task(Model):
         "engine": "str",      # "process" (default: node sandbox/inline) or
                               # "device": the run executes as ONE SPMD
                               # program over the nodes' global device mesh
+        # distributed tracing (runtime.tracing): the creating request's
+        # trace context. trace_id groups every span of this task's
+        # federated round; traceparent is the full W3C header the daemons
+        # parent their claim/exec/report spans on.
+        "trace_id": "str",
+        "traceparent": "str",
     }
 
     def runs(self) -> list["TaskRun"]:
@@ -352,6 +358,8 @@ class Task(Model):
             "session": {"id": self.session_id} if self.session_id else None,
             "store_as": self.store_as or None,
             "engine": self.engine or "process",
+            "trace_id": self.trace_id or None,
+            "traceparent": self.traceparent or None,
             "runs": [r.id for r in self.runs()],
         }
 

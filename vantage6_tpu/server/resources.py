@@ -204,12 +204,38 @@ def register_resources(srv: "ServerApp") -> None:
     # ------------------------------------------------------------- service
     @app.route("/api/health")
     def health(req: Request):
+        from vantage6_tpu import __version__
+        from vantage6_tpu.runtime.tracing import TRACER
+
         return {
             "status": "ok",
             "uptime": time.time() - srv.started_at,
+            "version": __version__,
             # advertised so nodes/UIs can upgrade from polling to push
             "websocket_url": srv.ws_url,
+            # capability flags the clients probe (see docs/observability.md)
+            "long_poll": True,
+            "metrics": "/api/metrics",
+            "tracing": TRACER.enabled,
         }
+
+    @app.route("/api/metrics")
+    def metrics(req: Request):
+        """Prometheus text exposition of the unified telemetry registry:
+        wire, REST, HTTP, executor-queue, event-hub, cache-hit and tracing
+        series in one scrape (docs/observability.md). Unauthenticated by
+        design, like /api/health — it carries aggregate counters only,
+        never payloads or principals."""
+        from vantage6_tpu.common.telemetry import (
+            PROMETHEUS_CONTENT_TYPE,
+            REGISTRY,
+        )
+        from vantage6_tpu.server.web import Response
+
+        return Response(
+            REGISTRY.render_prometheus(),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        )
 
     @app.route("/api/version")
     def version(req: Request):
@@ -1497,7 +1523,9 @@ def register_resources(srv: "ServerApp") -> None:
         return _store_forward(req, f"review/{id}")
 
     # --------------------------------------------------------------- events
-    @app.route("/api/event", methods=("GET",))
+    # untimed: the ?wait=S long-poll blocks by design and must not skew
+    # the v6t_http_request_seconds histogram (see web.App.route)
+    @app.route("/api/event", methods=("GET",), untimed=True)
     def events_fetch(req: Request):
         """Cursor catch-up (reference: socket reconnect re-sync) — now
         long-poll capable: `?wait=S` blocks up to S seconds (capped at 25)
@@ -1671,6 +1699,14 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
                 "and a duplicate or missing run would hang it",
             )
 
+    # distributed tracing: persist the creating request's trace context on
+    # the task. The current context here is the server's own http span
+    # (child of the client's traceparent header), so daemon claim/exec/
+    # report spans parented on it chain client → server → daemon in one
+    # trace. No ambient trace (old client, tracing off) → NULLs.
+    from vantage6_tpu.runtime.tracing import TRACER
+
+    trace_ctx = TRACER.current_context()
     task = m.Task(
         name=body["name"],
         description=body["description"],
@@ -1685,6 +1721,8 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
         session_id=session_id,
         store_as=store_as,
         engine=engine,
+        trace_id=trace_ctx.trace_id if trace_ctx else None,
+        traceparent=trace_ctx.to_traceparent() if trace_ctx else None,
     ).save()
     if store_as is not None:
         df = m.SessionDataframe.first(
@@ -1703,34 +1741,41 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
     task.save()
 
     method = body["method"]
-    for spec in org_specs:
-        org_id = int(spec["id"])
-        node = _node_for_org(collab.id, org_id)
-        run = m.TaskRun(
-            task_id=task.id,
-            organization_id=org_id,
-            node_id=node.id if node else None,
-            status=TaskStatus.PENDING.value,
-            input=spec.get("input", ""),
-            assigned_at=time.time(),
-        ).save()
-        if node:
-            srv.hub.emit(
-                ev.TASK_CREATED,
-                {
-                    "task_id": task.id,
-                    "run_id": run.id,
-                    "method": method,
-                    "image": task.image,
-                    "organization_id": org_id,
-                },
-                room=ev.node_room(node.id),
-            )
-    srv.hub.emit(
-        ev.TASK_CREATED,
-        {"task_id": task.id, "image": task.image},
-        room=ev.collaboration_room(collab.id),
-    )
+    # the run fan-out + event emits ARE "server dispatch" — one span so
+    # the timeline separates dispatch cost from the surrounding request
+    with TRACER.span(
+        "server.dispatch", kind="dispatch", service="server",
+        attrs={"task_id": task.id, "n_runs": len(org_specs)},
+        require_parent=True,
+    ):
+        for spec in org_specs:
+            org_id = int(spec["id"])
+            node = _node_for_org(collab.id, org_id)
+            run = m.TaskRun(
+                task_id=task.id,
+                organization_id=org_id,
+                node_id=node.id if node else None,
+                status=TaskStatus.PENDING.value,
+                input=spec.get("input", ""),
+                assigned_at=time.time(),
+            ).save()
+            if node:
+                srv.hub.emit(
+                    ev.TASK_CREATED,
+                    {
+                        "task_id": task.id,
+                        "run_id": run.id,
+                        "method": method,
+                        "image": task.image,
+                        "organization_id": org_id,
+                    },
+                    room=ev.node_room(node.id),
+                )
+        srv.hub.emit(
+            ev.TASK_CREATED,
+            {"task_id": task.id, "image": task.image},
+            room=ev.collaboration_room(collab.id),
+        )
     return task.to_dict(), 201
 
 

@@ -12,6 +12,7 @@ import io
 import json
 import re
 import threading
+import time
 import traceback
 from typing import Any, Callable
 from urllib.parse import parse_qs
@@ -19,8 +20,16 @@ from socketserver import ThreadingMixIn
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.common.telemetry import REGISTRY
+from vantage6_tpu.runtime.tracing import TRACER, parse_traceparent
 
 log = setup_logging("vantage6_tpu/web")
+
+# process-wide HTTP telemetry (covers every App in the process: the
+# control-plane server AND the node proxy relay)
+_HTTP_REQUESTS = REGISTRY.counter("v6t_http_requests_total")
+_HTTP_ERRORS = REGISTRY.counter("v6t_http_errors_total")
+_HTTP_SECONDS = REGISTRY.histogram("v6t_http_request_seconds")
 
 
 _UNPARSED = object()
@@ -127,19 +136,35 @@ class App:
 
     def __init__(self, name: str = "app"):
         self.name = name
-        # (regex, {method: handler})
-        self._routes: list[tuple[re.Pattern[str], dict[str, Handler]]] = []
+        # (regex, {method: handler}, original pattern — the low-cardinality
+        # span/metric label: "/api/run/<int:id>" instead of "/api/run/17")
+        self._routes: list[
+            tuple[re.Pattern[str], dict[str, Handler], str]
+        ] = []
+        # route patterns excluded from the latency histogram: long-poll
+        # endpoints block by DESIGN (up to 25 s) and would otherwise
+        # dominate the p95 the metric exists to report. Declared at
+        # registration (`untimed=True`) — route semantics belong to the
+        # route, not to query-param sniffing in the shared request path.
+        self._untimed: set[str] = set()
         self._auth_hook: Callable[[Request], None] | None = None
 
-    def route(self, pattern: str, methods: tuple[str, ...] = ("GET",)):
+    def route(
+        self,
+        pattern: str,
+        methods: tuple[str, ...] = ("GET",),
+        untimed: bool = False,
+    ):
         regex = self._compile(pattern)
+        if untimed:
+            self._untimed.add(pattern)
         def deco(fn: Handler) -> Handler:
-            for existing, table in self._routes:
+            for existing, table, _pat in self._routes:
                 if existing.pattern == regex.pattern:
                     for m in methods:
                         table[m] = fn
                     return fn
-            self._routes.append((regex, {m: fn for m in methods}))
+            self._routes.append((regex, {m: fn for m in methods}, pattern))
             return fn
         return deco
 
@@ -164,7 +189,7 @@ class App:
 
     # ---------------------------------------------------------------- serve
     def handle(self, request: Request) -> Response:
-        for regex, table in self._routes:
+        for regex, table, pattern in self._routes:
             m = regex.match(request.path)
             if not m:
                 continue
@@ -175,20 +200,47 @@ class App:
                 k: int(v) if v.isdigit() else v
                 for k, v in m.groupdict().items()
             }
-            try:
-                if self._auth_hook is not None:
-                    self._auth_hook(request)
-                out = handler(request, **kwargs)
-            except HTTPError as e:
-                return Response({"msg": e.msg}, e.status)
-            except Exception:
-                log.error(
-                    "500 on %s %s\n%s",
-                    request.method,
-                    request.path,
-                    traceback.format_exc(limit=8),
-                )
-                return Response({"msg": "internal server error"}, 500)
+            t0 = time.perf_counter()
+            _HTTP_REQUESTS.inc()
+            # long-poll routes are counted but not timed (see _untimed)
+            observe = pattern not in self._untimed
+            # join the caller's trace when the request carries one
+            # (require_parent: a bare poll must not mint a root trace per
+            # request); the span stays current for the handler's own
+            # child spans and any onward pooled_request relays
+            parent = parse_traceparent(
+                request.headers.get("traceparent")
+            )
+            with TRACER.span(
+                f"http {request.method} {pattern}", kind="server",
+                parent=parent, service=self.name, require_parent=True,
+            ) as span:
+                try:
+                    if self._auth_hook is not None:
+                        self._auth_hook(request)
+                    out = handler(request, **kwargs)
+                except HTTPError as e:
+                    span.set_attr(status_code=e.status)
+                    if e.status >= 500:
+                        span.set_status("error")
+                        _HTTP_ERRORS.inc()
+                    if observe:
+                        _HTTP_SECONDS.observe(time.perf_counter() - t0)
+                    return Response({"msg": e.msg}, e.status)
+                except Exception:
+                    log.error(
+                        "500 on %s %s\n%s",
+                        request.method,
+                        request.path,
+                        traceback.format_exc(limit=8),
+                    )
+                    span.set_status("error")
+                    _HTTP_ERRORS.inc()
+                    if observe:
+                        _HTTP_SECONDS.observe(time.perf_counter() - t0)
+                    return Response({"msg": "internal server error"}, 500)
+            if observe:
+                _HTTP_SECONDS.observe(time.perf_counter() - t0)
             if isinstance(out, Response):
                 return out
             if isinstance(out, tuple):
