@@ -272,6 +272,45 @@ class TestRuntimeSessions:
         with pytest.raises(KeyError):
             fed.session_dataframes(s)
 
+    def test_delete_session_while_store_as_run_executes(self):
+        """A session deleted while a store_as run is mid-execution must
+        neither crash the run (the bookkeeping vanished under it) nor
+        leave an orphaned dataframe re-inserted after the cleanup —
+        _store_session_result and delete_session share one locked region
+        gated on the session still existing."""
+        import threading as _threading
+
+        from vantage6_tpu.algorithm.decorators import data
+        from vantage6_tpu.runtime.federation import federation_from_datasets
+
+        started = _threading.Event()
+        proceed = _threading.Event()
+
+        @data(1)
+        def slow_extract(df):
+            started.set()
+            assert proceed.wait(10)
+            return df
+
+        fed = federation_from_datasets(
+            [pd.DataFrame({"age": [1.0, 2.0]})],
+            {"algo": {"slow_extract": slow_extract}},
+            executor_workers=1,
+        )
+        s = fed.create_session("doomed")
+        t = fed.create_task(
+            "algo", {"method": "slow_extract"},
+            session=s, store_as="x", wait=False,
+        )
+        assert started.wait(10)
+        fed.delete_session(s)  # mid-execution: bookkeeping disappears
+        proceed.set()
+        metas = fed.wait_for_results(t.id)  # completes, does not crash
+        assert metas[0]["stored"] == "x"
+        # no orphaned store survived the delete
+        assert all(s not in store for store in fed._session_stores)
+        fed.close()
+
     def test_validation(self):
         fed, _ = self._fed()
         with pytest.raises(ValueError, match="requires a session"):

@@ -1,0 +1,94 @@
+"""v6lint — AST-based invariant analyzer for vantage6-tpu.
+
+Four passes over the package's ASTs (no package import, no jax import —
+pure parsing, so a full run stays well under the 10 s CI budget):
+
+1. **lock discipline** (``locks.py``) — blocking calls under locks,
+   acquire/release hygiene, the cross-module lock-order graph, and
+   ``# guarded-by:`` field annotations.
+2. **JAX tracer hygiene** (``tracers.py``) — host syncs, impure calls and
+   donated-buffer reuse in code reachable from traced entry points.
+3. **contract drift** (``contracts.py``) — route/method agreement between
+   ``@app.route`` tables and REST call sites; wire-format tag constants.
+4. **telemetry coherence** (``telemetry.py``) — every instantiated metric
+   declared in ``KNOWN_METRICS``, every declared metric alive.
+
+Pre-existing, *justified* findings live in ``baseline.toml`` (one reason
+per waiver); anything new fails CI via ``tools/check_collect.py``. See
+docs/static_analysis.md for the rule catalog and the waiver workflow.
+
+Usage::
+
+    python -m tools.analyze              # human output, exit 1 on findings
+    python -m tools.analyze --json       # machine output (CI gate)
+    python -m tools.analyze --waive      # fold current findings into the
+                                         # baseline (reasons stay TODO
+                                         # until a human writes them)
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .callgraph import Index
+from .contracts import audit_critical_routes, run_contract_pass
+from .locks import run_lock_pass
+from .model import (
+    AnalysisResult,
+    BaselineError,
+    Finding,
+    SourceFile,
+    load_baseline,
+    partition,
+    save_baseline,
+    walk_package,
+)
+from .telemetry import run_telemetry_pass
+from .tracers import run_tracer_pass
+
+__all__ = [
+    "AnalysisResult",
+    "BaselineError",
+    "Finding",
+    "Index",
+    "analyze",
+    "audit_critical_routes",
+    "build_index",
+    "default_baseline_path",
+    "load_baseline",
+    "save_baseline",
+]
+
+DEFAULT_SUBDIRS = ("vantage6_tpu",)
+
+_PASSES = (
+    run_lock_pass,
+    run_tracer_pass,
+    run_contract_pass,
+    run_telemetry_pass,
+)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.toml")
+
+
+def build_index(root: str, subdirs=DEFAULT_SUBDIRS, light: bool = False) -> Index:
+    """``light=True`` skips the call-graph edges — enough for the route
+    audit, ~4x cheaper than a full index."""
+    return Index(walk_package(root, subdirs), compute_edges=not light)
+
+
+def analyze(
+    root: str,
+    subdirs=DEFAULT_SUBDIRS,
+    baseline: dict[str, str] | None = None,
+) -> tuple[AnalysisResult, float]:
+    """Run every pass; returns (result, seconds)."""
+    t0 = time.perf_counter()
+    index = build_index(root, subdirs)
+    findings: list[Finding] = []
+    for p in _PASSES:
+        findings.extend(p(index))
+    result = partition(findings, baseline or {})
+    return result, time.perf_counter() - t0

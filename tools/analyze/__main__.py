@@ -1,0 +1,106 @@
+"""``python -m tools.analyze`` — the v6lint CLI.
+
+Exit codes: 0 = no unwaived findings; 1 = unwaived findings (or a
+malformed baseline); 2 = the analyzer itself failed. ``--json`` prints a
+machine shape (the ``check_collect.py`` gate consumes it); ``--waive``
+folds the current unwaived findings into the baseline, preserving every
+existing reason and dropping stale keys (new entries carry a TODO reason
+a human must replace before review).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    BaselineError,
+    analyze,
+    default_baseline_path,
+    load_baseline,
+    save_baseline,
+)
+
+_TODO_REASON = "TODO: justify this waiver (added by --waive)"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="v6lint",
+        description="AST-based invariant analyzer (lock discipline, JAX "
+        "tracer hygiene, wire/route/metric contracts)",
+    )
+    ap.add_argument(
+        "subdirs", nargs="*", default=[],
+        help="package dirs to analyze (default: vantage6_tpu)",
+    )
+    ap.add_argument("--root", default=None, help="repo root (default: auto)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--waive", action="store_true",
+        help="fold current unwaived findings into the baseline",
+    )
+    ap.add_argument("--baseline", default=None, help="baseline file path")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    baseline_path = args.baseline or default_baseline_path()
+    try:
+        baseline = load_baseline(baseline_path)
+    except BaselineError as e:
+        print(f"BASELINE MALFORMED: {e}", file=sys.stderr)
+        return 1
+
+    try:
+        result, seconds = analyze(
+            root, subdirs=tuple(args.subdirs) or ("vantage6_tpu",),
+            baseline=baseline,
+        )
+    except Exception as e:  # pragma: no cover - analyzer bug, not findings
+        import traceback
+
+        traceback.print_exc()
+        print(f"v6lint internal error: {e!r}", file=sys.stderr)
+        return 2
+
+    if args.waive:
+        merged = {
+            k: r for k, r in baseline.items()
+            if any(f.key == k for f in result.waived)
+        }
+        for f in result.unwaived:
+            merged[f.key] = _TODO_REASON
+        save_baseline(baseline_path, merged)
+        dropped = sorted(set(baseline) - set(merged))
+        print(
+            f"baseline regenerated: {len(merged)} waiver(s) "
+            f"({len(result.unwaived)} new with TODO reasons, "
+            f"{len(dropped)} stale dropped) -> {baseline_path}"
+        )
+        for k in dropped:
+            print(f"  dropped stale: {k}")
+        return 0
+
+    if args.as_json:
+        out = result.to_dict()
+        out["seconds"] = round(seconds, 3)
+        print(json.dumps(out, indent=2))
+    else:
+        for f in result.unwaived:
+            print(f.render())
+        for k in result.stale_waivers:
+            print(f"stale waiver (no matching finding, remove it): {k}")
+        print(
+            f"v6lint: {len(result.unwaived)} unwaived finding(s), "
+            f"{len(result.waived)} waived by {os.path.basename(baseline_path)}, "
+            f"{len(result.stale_waivers)} stale waiver(s) "
+            f"[{seconds:.2f}s]"
+        )
+    return 1 if result.unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,554 @@
+"""v6lint package index: modules, classes, functions, locks, call edges.
+
+One walk over the package ASTs builds everything the passes share:
+
+- per-module import maps (``jnp`` -> ``jax.numpy``, ``RestSession`` ->
+  ``vantage6_tpu.common.rest.RestSession``),
+- every function/method (including nested closures — a closure defined in
+  a method shares the method's class context, so ``self`` resolution and
+  guarded-by checks see through it),
+- per-class lock attributes (``self._lock = threading.Lock()``,
+  ``Condition(self._lock)`` aliasing, ``dataclasses.field(default_factory=
+  threading.Lock)``), module-level locks, and light attribute typing
+  (``self._executor = StationExecutor(...)`` -> cross-module call edges),
+- best-effort call resolution (``self.m()``, ``self.attr.m()``, bare
+  names, imported names) feeding two fixpoints: *may this function block?*
+  and *which locks may this function acquire?* — the interprocedural
+  halves of the lock-discipline pass.
+
+Resolution is deliberately conservative: an unresolvable call contributes
+no edge (never a finding by itself), so imprecision produces missed
+findings, not noise. The waiver baseline absorbs the judged remainder.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Iterable, Iterator
+
+from .model import SourceFile
+
+# receiver-typed attributes worth tracking beyond package classes: the
+# stdlib concurrency types whose methods block or synchronize
+_STDLIB_TYPES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Event": "event",
+    "threading.Thread": "thread",
+    "queue.Queue": "queue",
+    "queue.PriorityQueue": "queue",
+    "queue.LifoQueue": "queue",
+    "concurrent.futures.ThreadPoolExecutor": "pool",
+}
+
+LockId = tuple[str, str]  # (owner: "module.Class" | module, attr name)
+
+
+@dataclasses.dataclass
+class LockDef:
+    attr: str
+    kind: str  # "lock" | "rlock" | "condition" | "event"
+    backing: str | None = None  # Condition(self._x): alias of lock attr _x
+    line: int = 0
+
+    @property
+    def reentrant(self) -> bool:
+        # threading.Condition() without an explicit lock creates an RLock
+        return self.kind in ("rlock", "condition")
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str  # "pkg.mod:Class.method" / "pkg.mod:fn" / "...fn.<locals>.g"
+    module: str
+    rel: str
+    node: Any  # ast.FunctionDef | ast.AsyncFunctionDef
+    cls: "ClassInfo | None"
+    parent: "FuncInfo | None"
+    nested: dict[str, "FuncInfo"] = dataclasses.field(default_factory=dict)
+    # fixpoint outputs (filled by Index.compute_reachability)
+    may_block: bool = False
+    block_witness: str = ""
+    direct_blocking: list[tuple[int, str]] = dataclasses.field(default_factory=list)
+    direct_locks: set[LockId] = dataclasses.field(default_factory=set)
+    reachable_locks: set[LockId] = dataclasses.field(default_factory=set)
+    callees: set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def short(self) -> str:
+        return self.qualname.split(":", 1)[1]
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: str
+    rel: str
+    node: ast.ClassDef
+    locks: dict[str, LockDef] = dataclasses.field(default_factory=dict)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    # guarded-by annotations: field attr -> (lock attr, line of annotation)
+    guarded: dict[str, tuple[str, int]] = dataclasses.field(default_factory=dict)
+    methods: dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}.{self.name}"
+
+    def canonical_lock(self, attr: str) -> LockId | None:
+        """LockId for ``self.<attr>``, following Condition-over-lock
+        aliasing (``Condition(self._lock)`` IS ``_lock``)."""
+        d = self.locks.get(attr)
+        if d is None:
+            return None
+        if d.backing and d.backing in self.locks:
+            return (self.qualname, d.backing)
+        return (self.qualname, attr)
+
+
+def walk_prune(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/class
+    bodies — their statements do not execute where they are defined, so
+    a ``with lock:`` region must not claim them."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chain as a dotted string (None if not one)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    def __init__(self, src: SourceFile, module: str):
+        self.src = src
+        self.module = module
+        self.imports: dict[str, str] = {}  # local name -> qualified target
+        self.functions: dict[str, FuncInfo] = {}  # top-level name -> info
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_locks: dict[str, LockDef] = {}
+
+    def resolve_name(self, name: str) -> str | None:
+        """Qualified target of a bare name via this module's imports."""
+        head, _, rest = name.partition(".")
+        target = self.imports.get(head)
+        if target is None:
+            return None
+        return f"{target}.{rest}" if rest else target
+
+
+class Index:
+    """The whole-package symbol table + call graph."""
+
+    def __init__(
+        self,
+        files: list[SourceFile],
+        package_root: str = "vantage6_tpu",
+        compute_edges: bool = True,
+    ):
+        """``compute_edges=False`` skips the call-graph edge computation —
+        the expensive part — for consumers that only need the symbol
+        tables (the CI route audit)."""
+        self.package_root = package_root
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}  # qualname -> info
+        self.classes: dict[str, ClassInfo] = {}  # "module.Class" -> info
+        for src in files:
+            self._index_file(src)
+        self._collect_class_state()
+        if compute_edges:
+            self.compute_reachability()
+
+    # ------------------------------------------------------------ building
+    def _module_name(self, rel: str) -> str:
+        mod = rel[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+    def _index_file(self, src: SourceFile) -> None:
+        mi = ModuleInfo(src, self._module_name(src.rel))
+        self.modules[mi.module] = mi
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mi.imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+                    if alias.asname:
+                        mi.imports[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    mi.imports[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+        for stmt in src.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(mi, stmt, cls=None, parent=None)
+            elif isinstance(stmt, ast.ClassDef):
+                self._index_class(mi, stmt)
+            elif isinstance(stmt, ast.Assign):
+                self._maybe_module_lock(mi, stmt)
+
+    def _maybe_module_lock(self, mi: ModuleInfo, stmt: ast.Assign) -> None:
+        kind = self._lock_ctor_kind(mi, stmt.value)
+        if kind is None:
+            return
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                mi.module_locks[tgt.id] = LockDef(tgt.id, kind, line=stmt.lineno)
+
+    def _lock_ctor_kind(self, mi: ModuleInfo, value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        name = dotted(value.func)
+        if name is None:
+            return None
+        resolved = mi.resolve_name(name) or name
+        return {
+            "threading.Lock": "lock",
+            "threading.RLock": "rlock",
+            "threading.Condition": "condition",
+            "threading.Event": "event",
+        }.get(resolved)
+
+    def _index_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(node.name, mi.module, mi.src.rel, node)
+        mi.classes[node.name] = ci
+        self.classes[ci.qualname] = ci
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(mi, stmt, cls=ci, parent=None)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                # dataclass field: _lock: threading.Lock = field(
+                #     default_factory=threading.Lock)
+                kind = self._field_factory_lock(mi, stmt.value)
+                if kind is not None:
+                    ci.locks[stmt.target.id] = LockDef(
+                        stmt.target.id, kind, line=stmt.lineno
+                    )
+
+    def _field_factory_lock(self, mi: ModuleInfo, value: ast.AST | None) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        fname = dotted(value.func)
+        if fname is None or (mi.resolve_name(fname) or fname) not in (
+            "dataclasses.field",
+            "field",
+        ):
+            return None
+        for kw in value.keywords:
+            if kw.arg == "default_factory":
+                factory = dotted(kw.value)
+                if factory is not None:
+                    return {
+                        "threading.Lock": "lock",
+                        "threading.RLock": "rlock",
+                        "threading.Condition": "condition",
+                    }.get(mi.resolve_name(factory) or factory)
+        return None
+
+    def _index_function(
+        self,
+        mi: ModuleInfo,
+        node: ast.FunctionDef | ast.AsyncFunctionDef,
+        cls: ClassInfo | None,
+        parent: FuncInfo | None,
+    ) -> None:
+        if parent is None:
+            short = f"{cls.name}.{node.name}" if cls else node.name
+        else:
+            short = f"{parent.short}.<locals>.{node.name}"
+        fi = FuncInfo(
+            qualname=f"{mi.module}:{short}",
+            module=mi.module,
+            rel=mi.src.rel,
+            node=node,
+            cls=cls,
+            parent=parent,
+        )
+        self.functions[fi.qualname] = fi
+        if parent is not None:
+            parent.nested[node.name] = fi
+        elif cls is not None:
+            cls.methods[node.name] = fi
+        else:
+            mi.functions[node.name] = fi
+        for stmt in ast.walk(node):
+            if stmt is not node and isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                if self._enclosing_is(node, stmt):
+                    self._index_function(mi, stmt, cls=cls, parent=fi)
+
+    @staticmethod
+    def _enclosing_is(outer: ast.AST, inner: ast.AST) -> bool:
+        """True when ``inner`` is DIRECTLY nested in ``outer`` (not via an
+        intermediate def — those index through their own parent)."""
+        for node in walk_prune(outer):
+            for child in ast.iter_child_nodes(node):
+                if child is inner:
+                    return True
+        return False
+
+    # ------------------------------------------------- class state discovery
+    def _collect_class_state(self) -> None:
+        """Second pass over every method body: lock attrs, attribute types
+        and guarded-by annotations (needs all classes known for typing)."""
+        for ci in self.classes.values():
+            mi = self.modules[ci.module]
+            for meth in ci.methods.values():
+                self._scan_self_assigns(mi, ci, meth)
+        # guarded-by comments ride the raw source, not the AST
+        for ci in self.classes.values():
+            self._scan_guarded_comments(ci)
+
+    def _scan_self_assigns(self, mi: ModuleInfo, ci: ClassInfo, meth: FuncInfo) -> None:
+        for stmt in walk_prune(meth.node):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            value = stmt.value
+            for tgt in targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                attr = tgt.attr
+                kind = self._lock_ctor_kind(mi, value) if value is not None else None
+                if kind is not None:
+                    backing = None
+                    if kind == "condition" and isinstance(value, ast.Call):
+                        for arg in value.args[:1]:
+                            if (
+                                isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"
+                            ):
+                                backing = arg.attr
+                    ci.locks.setdefault(
+                        attr, LockDef(attr, kind, backing, stmt.lineno)
+                    )
+                    continue
+                if isinstance(value, ast.Call):
+                    tname = dotted(value.func)
+                    if tname is not None:
+                        resolved = mi.resolve_name(tname) or tname
+                        if resolved in self.classes or resolved in _STDLIB_TYPES:
+                            ci.attr_types.setdefault(attr, resolved)
+
+    def _scan_guarded_comments(self, ci: ClassInfo) -> None:
+        """``# guarded-by: <lock attr>`` on (or directly above) a
+        ``self.X = ...`` assignment registers X as lock-guarded state."""
+        import re
+
+        src = self.modules[ci.module].src
+        # anywhere inside a comment — `# guarded-by: _lock` and prose
+        # forms like `# round-robin start — guarded-by: _cond` both count
+        pat = re.compile(r"#.*?guarded-by:\s*([A-Za-z_]\w*)")
+        for meth in ci.methods.values():
+            for stmt in walk_prune(meth.node):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                for tgt in targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    for lineno in (stmt.lineno, stmt.lineno - 1):
+                        if not 1 <= lineno <= len(src.lines):
+                            continue
+                        line = src.lines[lineno - 1]
+                        # the line ABOVE only counts when it is a pure
+                        # comment — a neighbouring field's same-line
+                        # annotation must not bleed onto this one
+                        if lineno != stmt.lineno and not line.lstrip().startswith("#"):
+                            continue
+                        m = pat.search(line)
+                        if m:
+                            ci.guarded.setdefault(tgt.attr, (m.group(1), lineno))
+                            break
+
+    # ------------------------------------------------------ call resolution
+    def resolve_call(self, fi: FuncInfo, call: ast.Call) -> FuncInfo | str | None:
+        """Best-effort target of ``call`` inside ``fi``: a package
+        FuncInfo, a qualified external name string ("time.sleep"), or
+        None when unresolvable."""
+        func = call.func
+        mi = self.modules[fi.module]
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested defs visible in the scope chain
+            scope: FuncInfo | None = fi
+            while scope is not None:
+                if name in scope.nested:
+                    return scope.nested[name]
+                scope = scope.parent
+            if name in mi.functions:
+                return mi.functions[name]
+            if name in mi.classes:
+                init = mi.classes[name].methods.get("__init__")
+                return init if init is not None else f"{mi.module}.{name}"
+            resolved = mi.resolve_name(name)
+            if resolved is not None:
+                return self._qualified_target(resolved)
+            return None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            attr = func.attr
+            if isinstance(base, ast.Name) and base.id == "self" and fi.cls is not None:
+                meth = fi.cls.methods.get(attr)
+                return meth if meth is not None else None
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and fi.cls is not None
+            ):
+                tname = fi.cls.attr_types.get(base.attr)
+                if tname in self.classes:
+                    return self.classes[tname].methods.get(attr)
+                if tname in _STDLIB_TYPES:
+                    return f"{tname}.{attr}"
+                return None
+            chain = dotted(func)
+            if chain is not None:
+                resolved = mi.resolve_name(chain)
+                if resolved is not None:
+                    return self._qualified_target(resolved)
+                return chain  # e.g. module alias chains kept verbatim
+        return None
+
+    def _qualified_target(self, qualified: str) -> FuncInfo | str:
+        """Map a fully qualified name onto an indexed function if the
+        module lives inside the package; external names stay strings."""
+        mod, _, rest = qualified.rpartition(".")
+        mi = self.modules.get(mod)
+        if mi is not None and rest in mi.functions:
+            return mi.functions[rest]
+        if mi is not None and rest in mi.classes:
+            init = mi.classes[rest].methods.get("__init__")
+            if init is not None:
+                return init
+        # "pkg.mod.Class.method" two-level resolution
+        mod2, _, cls_name = mod.rpartition(".")
+        ci = self.classes.get(f"{mod2}.{cls_name}") if mod2 else None
+        if ci is not None and rest in ci.methods:
+            return ci.methods[rest]
+        return qualified
+
+    # ----------------------------------------------------------- fixpoints
+    def compute_reachability(self) -> None:
+        """Fill per-function callee edges. Direct facts (blocking calls,
+        lock acquisitions) are written into each FuncInfo by the locks
+        pass, which then calls ``propagate()``; edges are computed here
+        so the contracts/tracer passes work standalone too."""
+        for fi in self.functions.values():
+            fi.callees = set()
+            for node in walk_prune(fi.node):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_call(fi, node)
+                    if isinstance(target, FuncInfo):
+                        fi.callees.add(target.qualname)
+
+    def propagate(self) -> None:
+        """Fixed-point propagation of may_block / reachable_locks along
+        the resolved call graph (callers inherit their callees' facts)."""
+        for fi in self.functions.values():
+            fi.may_block = bool(fi.direct_blocking)
+            fi.block_witness = (
+                fi.direct_blocking[0][1] if fi.direct_blocking else ""
+            )
+            fi.reachable_locks = set(fi.direct_locks)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions.values():
+                for callee_name in fi.callees:
+                    callee = self.functions.get(callee_name)
+                    if callee is None:
+                        continue
+                    if callee.may_block and not fi.may_block:
+                        fi.may_block = True
+                        fi.block_witness = (
+                            f"{callee.short} -> {callee.block_witness}"
+                            if callee.block_witness
+                            else callee.short
+                        )
+                        changed = True
+                    new_locks = callee.reachable_locks - fi.reachable_locks
+                    if new_locks:
+                        fi.reachable_locks |= new_locks
+                        changed = True
+
+    # ------------------------------------------------------------- helpers
+    def all_functions(self) -> Iterable[FuncInfo]:
+        return self.functions.values()
+
+    def find_module(self, dotted_name: str) -> "ModuleInfo | None":
+        """Module by exact dotted name, or by suffix — fixture trees
+        analyze the same files under a prefix directory."""
+        mi = self.modules.get(dotted_name)
+        if mi is not None:
+            return mi
+        for name, mi in self.modules.items():
+            if name.endswith("." + dotted_name):
+                return mi
+        return None
+
+    def lock_for_with_item(
+        self, fi: FuncInfo, expr: ast.AST
+    ) -> tuple[LockId, LockDef] | None:
+        """Resolve a ``with <expr>:`` context manager to a lock, or None
+        for ordinary context managers (spans, files, ...)."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fi.cls is not None
+        ):
+            lock_id = fi.cls.canonical_lock(expr.attr)
+            if lock_id is not None:
+                return lock_id, fi.cls.locks[expr.attr]
+            return None
+        name = dotted(expr)
+        if name is None:
+            return None
+        mi = self.modules[fi.module]
+        head, _, rest = name.partition(".")
+        if not rest and head in mi.module_locks:
+            return (mi.module, head), mi.module_locks[head]
+        resolved = mi.resolve_name(name)
+        if resolved is not None:
+            mod, _, attr = resolved.rpartition(".")
+            other = self.modules.get(mod)
+            if other is not None and attr in other.module_locks:
+                return (mod, attr), other.module_locks[attr]
+        return None

@@ -1,0 +1,337 @@
+"""v6lint pass 3 — wire/route contract drift.
+
+The control plane's agreement between server route table and client call
+sites used to be audited by substring matching in ``check_collect.py``;
+this pass re-implements it on real ASTs:
+
+- **Route table**: every ``@app.route("/api/...", methods=(...))``
+  decorator in the package (server resources, node proxy, algorithm
+  store, UI) parsed with its HTTP methods.
+- **Call sites**: every call carrying a constant HTTP verb followed by a
+  constant (or f-string) endpoint path — ``session.request("GET",
+  "event")``, ``self._forward(req, "GET", f"organization/{id}")``, the
+  batch reporter's ``PATCH run/batch`` — matched segment-wise against the
+  route table, f-string placeholders matching route placeholders.
+
+Rules:
+
+- ``route-unknown``: a call site names an endpoint no route serves — the
+  request 404s at runtime, but only on the code path that sends it.
+- ``route-method-mismatch``: the endpoint exists but not for that verb —
+  the server answers 405 and (worse) capability-probing daemons pin
+  themselves to legacy fallbacks forever.
+- ``wire-magic-drift``: the framed wire-format tag constants
+  (``serialization.MAGIC_V2`` = ``b"V6T\\x02"``, ``encryption.ENC_MAGIC``
+  = ``b"V6TE\\x02"``) changed value, changed prefix family, or became
+  prefixes of each other — committed task blobs and cross-version peers
+  decode by exactly these bytes (same stance as the golden-blob gate).
+- ``wire-magic-inline``: a module OTHER than the defining one spells a
+  ``V6T``-family frame tag as a literal instead of importing the
+  constant — the drift vector the constants exist to prevent.
+
+``audit_critical_routes`` is the ``check_collect.py`` entry point: the
+must-stay-wired endpoint map lives there (it is CI policy, not analyzer
+mechanics); this function gives it AST-backed facts.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .callgraph import Index, walk_prune
+from .model import Finding
+
+_HTTP_VERBS = {"GET", "POST", "PATCH", "DELETE", "PUT", "HEAD", "OPTIONS"}
+
+# the forever-constants (docs/wire_format.md): committed golden blobs and
+# cross-version peers decode by these exact bytes
+_EXPECTED_MAGIC = {
+    "vantage6_tpu.common.serialization": ("MAGIC_V2", b"V6T\x02"),
+    "vantage6_tpu.common.encryption": ("ENC_MAGIC", b"V6TE\x02"),
+}
+_MAGIC_FAMILY_PREFIX = b"V6T"
+
+
+class Route:
+    def __init__(self, path: str, methods: set[str], rel: str, line: int):
+        self.path = path
+        self.segments = [s for s in path.strip("/").split("/") if s]
+        if self.segments and self.segments[0] == "api":
+            self.segments = self.segments[1:]
+        self.methods = methods
+        self.rel = rel
+        self.line = line
+
+
+class CallSite:
+    def __init__(
+        self, verb: str, segments: list[str | None], raw: str,
+        rel: str, line: int, context: str,
+    ):
+        self.verb = verb
+        self.segments = segments  # None = dynamic placeholder
+        self.raw = raw
+        self.rel = rel
+        self.line = line
+        self.context = context
+
+
+def collect_routes(index: Index) -> list[Route]:
+    routes: list[Route] = []
+    for mi in index.modules.values():
+        for node in ast.walk(mi.src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for deco in node.decorator_list:
+                if not (
+                    isinstance(deco, ast.Call)
+                    and isinstance(deco.func, ast.Attribute)
+                    and deco.func.attr == "route"
+                    and deco.args
+                    and isinstance(deco.args[0], ast.Constant)
+                    and isinstance(deco.args[0].value, str)
+                ):
+                    continue
+                methods = {"GET"}
+                for kw in deco.keywords:
+                    if kw.arg == "methods":
+                        try:
+                            methods = {
+                                str(m).upper()
+                                for m in ast.literal_eval(kw.value)
+                            }
+                        except ValueError:
+                            pass
+                routes.append(
+                    Route(deco.args[0].value, methods, mi.src.rel, deco.lineno)
+                )
+    return routes
+
+
+def _path_segments(expr: ast.AST) -> list[str | None] | None:
+    """Split a constant-or-f-string endpoint into segments; dynamic
+    pieces become None placeholders. Returns None for fully dynamic
+    paths (a Name/attribute) — those are relays, not auditable sites."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        text = expr.value
+    elif isinstance(expr, ast.JoinedStr):
+        parts: list[str] = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("\x00")  # placeholder marker
+        text = "".join(parts)
+    else:
+        return None
+    segs: list[str | None] = []
+    for seg in text.strip("/").split("/"):
+        if not seg:
+            continue
+        segs.append(None if "\x00" in seg else seg)
+    return segs
+
+
+def collect_call_sites(index: Index) -> list[CallSite]:
+    sites: list[CallSite] = []
+    for fi in index.all_functions():
+        for call in (n for n in walk_prune(fi.node) if isinstance(n, ast.Call)):
+            args = call.args
+            for i in range(len(args) - 1):
+                a = args[i]
+                if not (
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                    and a.value.upper() in _HTTP_VERBS
+                    and a.value.isupper()
+                ):
+                    continue
+                segs = _path_segments(args[i + 1])
+                if segs is None or not segs or segs[0] is None:
+                    # fully/leading-dynamic paths (generic resource
+                    # helpers, relays) carry no auditable contract
+                    break
+                raw = (
+                    args[i + 1].value
+                    if isinstance(args[i + 1], ast.Constant)
+                    else "/".join("<dyn>" if s is None else s for s in segs)
+                )
+                sites.append(
+                    CallSite(
+                        a.value.upper(), segs, raw, fi.rel, call.lineno,
+                        context=fi.short,
+                    )
+                )
+                break
+    return sites
+
+
+def _matches(site: CallSite, route: Route) -> bool:
+    if len(site.segments) != len(route.segments):
+        return False
+    for s, r in zip(site.segments, route.segments):
+        r_placeholder = r.startswith("<")
+        if s is None or r_placeholder:
+            continue  # a dynamic piece matches anything on the other side
+        if s != r:
+            return False
+    return True
+
+
+def run_contract_pass(index: Index) -> list[Finding]:
+    findings: list[Finding] = []
+    routes = collect_routes(index)
+    for site in collect_call_sites(index):
+        matching = [r for r in routes if _matches(site, r)]
+        if not matching:
+            findings.append(
+                Finding(
+                    "route-unknown", site.rel, site.line,
+                    f'{site.verb} "{site.raw}" matches no @app.route in the '
+                    "package — this request 404s at runtime",
+                    context=f"{site.context}#{site.verb} {site.raw}",
+                )
+            )
+            continue
+        if not any(site.verb in r.methods for r in matching):
+            allowed = sorted({m for r in matching for m in r.methods})
+            where = ", ".join(
+                f"{r.rel}:{r.line}" for r in matching[:2]
+            )
+            findings.append(
+                Finding(
+                    "route-method-mismatch", site.rel, site.line,
+                    f'{site.verb} "{site.raw}" but the route ({where}) only '
+                    f"allows {allowed} — the server answers 405",
+                    context=f"{site.context}#{site.verb} {site.raw}",
+                )
+            )
+    findings.extend(_check_wire_magic(index))
+    return findings
+
+
+# ------------------------------------------------------------- wire magic
+def _check_wire_magic(index: Index) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: dict[str, bytes] = {}
+    defining_rels: set[str] = set()
+    for mod, (const_name, expected) in _EXPECTED_MAGIC.items():
+        mi = index.find_module(mod)
+        if mi is None:
+            continue  # partial-tree run (fixtures/tests)
+        defining_rels.add(mi.src.rel)
+        value = None
+        line = 0
+        for stmt in mi.src.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == const_name
+                    for t in stmt.targets
+                )
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, bytes)
+            ):
+                value = stmt.value.value
+                line = stmt.lineno
+        if value is None:
+            findings.append(
+                Finding(
+                    "wire-magic-drift", mi.src.rel, 1,
+                    f"{const_name} (the {expected!r} frame tag) is no longer "
+                    "a module-level bytes constant — committed blobs and "
+                    "old peers decode by these exact bytes",
+                    context=const_name,
+                )
+            )
+            continue
+        seen[const_name] = value
+        if value != expected:
+            findings.append(
+                Finding(
+                    "wire-magic-drift", mi.src.rel, line,
+                    f"{const_name} changed from {expected!r} to {value!r} — "
+                    "a wire-compat break (docs/wire_format.md): every "
+                    "committed blob and cross-version peer stops decoding",
+                    context=const_name,
+                )
+            )
+    if len(seen) == 2:
+        a, b = seen.get("MAGIC_V2"), seen.get("ENC_MAGIC")
+        if a and b and (a.startswith(b) or b.startswith(a)):
+            findings.append(
+                Finding(
+                    "wire-magic-drift",
+                    "vantage6_tpu/common/encryption.py", 1,
+                    f"frame tags {a!r} and {b!r} are prefixes of one another"
+                    " — auto-detection (deserialize / decrypt_bytes) can no "
+                    "longer tell the frames apart",
+                    context="MAGIC_V2/ENC_MAGIC",
+                )
+            )
+    # inline re-spellings of the frame family outside the defining modules
+    for mi in index.modules.values():
+        if mi.src.rel in defining_rels:
+            continue
+        for node in ast.walk(mi.src.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, bytes)
+                and node.value.startswith(_MAGIC_FAMILY_PREFIX)
+            ):
+                findings.append(
+                    Finding(
+                        "wire-magic-inline", mi.src.rel, node.lineno,
+                        f"literal {node.value!r} re-spells a wire frame tag "
+                        "— import MAGIC_V2/ENC_MAGIC instead so a version "
+                        "bump cannot drift",
+                        context=f"{mi.module.rsplit('.', 1)[-1]}#inline-magic",
+                    )
+                )
+    return findings
+
+
+# ------------------------------------------- check_collect.py entry point
+def audit_critical_routes(
+    index: Index, route_audit: dict[str, Iterable[str]]
+) -> list[str]:
+    """The CI gate's must-stay-wired audit, AST-backed: each endpoint must
+    exist in the server route table AND be referenced by every file
+    ``route_audit`` names — as a string constant equal to the endpoint,
+    or one extending it into a sub-path/query (``"event?since="`` inside
+    an f-string still references ``event``). Message style matches the
+    historical ``check_collect`` output so CI logs stay familiar."""
+    problems: list[str] = []
+    server_routes = {
+        r.path
+        for r in collect_routes(index)
+        if r.rel == "vantage6_tpu/server/resources.py"
+    }
+    for endpoint, call_sites in route_audit.items():
+        if f"/api/{endpoint}" not in server_routes:
+            problems.append(
+                f"server route /api/{endpoint} is gone from "
+                "server/resources.py but daemons/clients still call it"
+            )
+        for rel in call_sites:
+            mod = index.modules.get(rel[:-3].replace("/", "."))
+            if mod is None:
+                problems.append(f"{rel}: call-site file not in the index")
+                continue
+            referenced = any(
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and (
+                    node.value == endpoint
+                    or node.value.startswith(endpoint + "/")
+                    or node.value.startswith(endpoint + "?")
+                )
+                for node in ast.walk(mod.src.tree)
+            )
+            if not referenced:
+                problems.append(
+                    f"{rel} no longer references endpoint {endpoint!r} — "
+                    "either the fast path was removed (update this audit) "
+                    "or the call site drifted from the route name"
+                )
+    return problems

@@ -1,0 +1,615 @@
+"""v6lint pass 1 — lock discipline.
+
+Rules (finding ids):
+
+- ``lock-blocking-call``: a *directly* blocking call (REST round-trip /
+  ``pooled_request`` / ``subprocess.*`` / ``time.sleep`` / ``Event.wait``
+  / queue ``get`` / thread ``join`` / ``Condition.wait`` on a DIFFERENT
+  lock) executed while holding a lock. Waiting on the condition you hold
+  is exempt — that wait releases the lock; that's what conditions are for.
+- ``lock-sqlite-under-lock``: sqlite ``execute*`` under a lock that is
+  not the database's own serialization lock (attr containing ``db`` or
+  ``memory``) — per-statement fsync latency under an unrelated lock turns
+  every contender into a disk-bound waiter.
+- ``lock-blocking-reach``: a call whose *transitive* callees block (the
+  call graph says so) while holding a lock — the interprocedural version
+  of ``lock-blocking-call``; the witness chain names the blocking leaf.
+- ``lock-acquire-no-finally``: explicit ``.acquire()`` on a lock without
+  a ``try/finally`` releasing it — an exception between acquire and
+  release leaks the lock forever.
+- ``lock-order-cycle``: the cross-module lock-order graph (edge A->B when
+  B is taken — directly or through calls — while A is held) contains a
+  cycle: two threads taking the locks in opposite orders deadlock.
+- ``lock-self-deadlock``: a non-reentrant lock (re)taken — directly or
+  through calls — while already held.
+- ``guarded-by-escape``: a write to a field annotated ``# guarded-by:
+  <lock>`` outside a ``with <lock>:`` region (``__init__`` and
+  ``*_locked``-suffixed methods are exempt by convention: construction
+  precedes sharing, and ``_locked`` names the caller-holds-it contract).
+- ``guarded-by-unknown-lock``: the annotation names a lock the class
+  does not define — dead armor.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any
+
+from .callgraph import ClassInfo, FuncInfo, Index, LockId, dotted, walk_prune
+from .model import Finding
+
+_HTTP_CALL_ATTRS = {"request", "paginate"}
+_SQLITE_ATTRS = {"execute", "executemany", "executescript"}
+_MUTATORS = {
+    "add", "discard", "remove", "pop", "popleft", "popitem", "append",
+    "appendleft", "extend", "extendleft", "insert", "clear",
+    "update", "setdefault", "put", "put_nowait",
+}
+_DB_LOCK_HINTS = ("db", "memory")
+
+
+def _lock_name(lock: LockId) -> str:
+    owner, attr = lock
+    return f"{owner.split('.')[-1]}.{attr}" if owner else attr
+
+
+@dataclasses.dataclass
+class _Edge:
+    src: LockId
+    dst: LockId
+    rel: str
+    line: int
+    desc: str
+
+
+class LockPass:
+    def __init__(self, index: Index):
+        self.index = index
+        self.findings: list[Finding] = []
+        self.edges: dict[tuple[LockId, LockId], _Edge] = {}
+        self.lock_kinds: dict[LockId, str] = {}
+
+    # ---------------------------------------------------------- entry point
+    def run(self) -> list[Finding]:
+        for fi in self.index.all_functions():
+            self._collect_direct_facts(fi)
+        self.index.propagate()
+        for fi in self.index.all_functions():
+            self._walk_function(fi)
+        self._check_guarded_annotations()
+        self._report_cycles()
+        return self.findings
+
+    # ------------------------------------------------------- blocking facts
+    def _blocking_symbol(
+        self, fi: FuncInfo, call: ast.Call, held: list[LockId]
+    ) -> tuple[str, str] | None:
+        """(symbol, rule) when ``call`` blocks. ``held`` refines the
+        Condition.wait exemption; pass [] when collecting context-free
+        facts for the may-block fixpoint."""
+        func = call.func
+        target = self.index.resolve_call(fi, call)
+        resolved = target if isinstance(target, str) else None
+        if resolved == "time.sleep":
+            return "time.sleep", "lock-blocking-call"
+        if resolved is not None and resolved.split(".")[0] == "subprocess":
+            return resolved, "lock-blocking-call"
+        if isinstance(func, ast.Name) and func.id == "pooled_request":
+            return "pooled_request", "lock-blocking-call"
+        if resolved is not None and resolved.endswith(".pooled_request"):
+            return "pooled_request", "lock-blocking-call"
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr in _HTTP_CALL_ATTRS:
+                return f"<rest>.{attr}", "lock-blocking-call"
+            if attr in _SQLITE_ATTRS:
+                return f"<db>.{attr}", "lock-sqlite-under-lock"
+            recv = self._receiver_lock(fi, func.value)
+            if attr == "wait" and recv is not None:
+                lock_id, kind = recv
+                if kind in ("condition", "rlock", "lock"):
+                    if lock_id in held:
+                        return None  # waiting on the held condition: by design
+                    return f"{_lock_name(lock_id)}.wait", "lock-blocking-call"
+                if kind == "event":
+                    return f"{_lock_name(lock_id)}.wait", "lock-blocking-call"
+            recv_type = self._receiver_type(fi, func.value)
+            if attr == "wait" and recv_type == "event":
+                return "Event.wait", "lock-blocking-call"
+            if attr in ("get",) and recv_type == "queue":
+                return "Queue.get", "lock-blocking-call"
+            if attr == "join" and recv_type in ("thread", "pool"):
+                return "Thread.join", "lock-blocking-call"
+        return None
+
+    def _receiver_lock(
+        self, fi: FuncInfo, expr: ast.AST
+    ) -> tuple[LockId, str] | None:
+        """Lock identity + kind of ``<expr>.wait()``-style receivers."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fi.cls is not None
+        ):
+            d = fi.cls.locks.get(expr.attr)
+            if d is not None:
+                lock_id = fi.cls.canonical_lock(expr.attr)
+                assert lock_id is not None
+                return lock_id, d.kind
+            return None
+        resolved = self.index.lock_for_with_item(fi, expr)
+        if resolved is not None:
+            return resolved[0], resolved[1].kind
+        return None
+
+    def _receiver_type(self, fi: FuncInfo, expr: ast.AST) -> str | None:
+        """Coarse stdlib type of a ``self.<attr>`` receiver (thread /
+        queue / pool / event) from the class's attribute-type map."""
+        from .callgraph import _STDLIB_TYPES
+
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fi.cls is not None
+        ):
+            t = fi.cls.attr_types.get(expr.attr)
+            if t in _STDLIB_TYPES:
+                return _STDLIB_TYPES[t]
+        return None
+
+    def _collect_direct_facts(self, fi: FuncInfo) -> None:
+        for node in walk_prune(fi.node):
+            if isinstance(node, ast.Call):
+                sym = self._blocking_symbol(fi, node, held=[])
+                if sym is not None and sym[1] != "lock-sqlite-under-lock":
+                    fi.direct_blocking.append((node.lineno, sym[0]))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    resolved = self.index.lock_for_with_item(
+                        fi, item.context_expr
+                    )
+                    if resolved is not None:
+                        fi.direct_locks.add(resolved[0])
+                        self.lock_kinds.setdefault(
+                            resolved[0], resolved[1].kind
+                        )
+
+    # --------------------------------------------------------- region walk
+    def _walk_function(self, fi: FuncInfo) -> None:
+        self._visit_block(fi, list(fi.node.body), held=[], finally_releases=set())
+
+    def _visit_block(
+        self,
+        fi: FuncInfo,
+        stmts: list[ast.stmt],
+        held: list[LockId],
+        finally_releases: set[str],
+    ) -> None:
+        for i, s in enumerate(stmts):
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                # items acquire LEFT TO RIGHT: `with a, b:` holds a while
+                # taking b, so each item sees the previously-acquired ones
+                acquired: list[LockId] = []
+                for item in s.items:
+                    self._scan_exprs(fi, item.context_expr, held + acquired)
+                    resolved = self.index.lock_for_with_item(fi, item.context_expr)
+                    if resolved is None:
+                        continue
+                    lock_id, ldef = resolved
+                    self._record_acquire(
+                        fi, lock_id, ldef.reentrant, held + acquired, s.lineno
+                    )
+                    acquired.append(lock_id)
+                self._visit_block(fi, s.body, held + acquired, finally_releases)
+            elif isinstance(s, ast.If) or isinstance(s, ast.While):
+                self._scan_exprs(fi, s.test, held)
+                self._visit_block(fi, s.body, held, finally_releases)
+                self._visit_block(fi, s.orelse, held, finally_releases)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                self._scan_exprs(fi, s.iter, held)
+                self._visit_block(fi, s.body, held, finally_releases)
+                self._visit_block(fi, s.orelse, held, finally_releases)
+            elif isinstance(s, ast.Match):
+                self._scan_exprs(fi, s.subject, held)
+                for case in s.cases:
+                    if case.guard is not None:
+                        self._scan_exprs(fi, case.guard, held)
+                    self._visit_block(fi, case.body, held, finally_releases)
+            elif isinstance(s, ast.Try):
+                inner = set(finally_releases)
+                inner |= self._released_in(s.finalbody)
+                self._visit_block(fi, s.body, held, inner)
+                for h in s.handlers:
+                    self._visit_block(fi, h.body, held, finally_releases)
+                self._visit_block(fi, s.orelse, held, inner)
+                self._visit_block(fi, s.finalbody, held, finally_releases)
+            else:
+                self._scan_exprs(fi, s, held)
+                self._check_bare_acquire(fi, s, stmts, i, finally_releases)
+
+    @staticmethod
+    def _released_in(stmts: list[ast.stmt]) -> set[str]:
+        out: set[str] = set()
+        for s in stmts:
+            for node in ast.walk(s):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "release"
+                ):
+                    recv = dotted(node.func.value)
+                    if recv is not None:
+                        out.add(recv)
+        return out
+
+    def _check_bare_acquire(
+        self,
+        fi: FuncInfo,
+        stmt: ast.stmt,
+        stmts: list[ast.stmt],
+        i: int,
+        finally_releases: set[str],
+    ) -> None:
+        for node in walk_prune(stmt):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                continue
+            if self._receiver_lock(fi, node.func.value) is None:
+                continue  # e.g. a session-pool acquire, not a lock
+            recv = dotted(node.func.value)
+            if recv in finally_releases:
+                continue
+            nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+            if isinstance(nxt, ast.Try) and recv in self._released_in(nxt.finalbody):
+                continue
+            self.findings.append(
+                Finding(
+                    "lock-acquire-no-finally",
+                    fi.rel,
+                    node.lineno,
+                    f"{recv}.acquire() without a try/finally release — an "
+                    "exception here leaks the lock forever",
+                    context=f"{fi.short}#{recv}",
+                )
+            )
+
+    def _record_acquire(
+        self,
+        fi: FuncInfo,
+        lock_id: LockId,
+        reentrant: bool,
+        held: list[LockId],
+        lineno: int,
+    ) -> None:
+        if lock_id in held and not reentrant:
+            self.findings.append(
+                Finding(
+                    "lock-self-deadlock",
+                    fi.rel,
+                    lineno,
+                    f"non-reentrant lock {_lock_name(lock_id)} re-acquired "
+                    "while already held — this thread deadlocks itself",
+                    context=f"{fi.short}#{_lock_name(lock_id)}",
+                )
+            )
+        for h in held:
+            if h != lock_id:
+                self.edges.setdefault(
+                    (h, lock_id),
+                    _Edge(h, lock_id, fi.rel, lineno,
+                          f"{fi.short} takes {_lock_name(lock_id)} "
+                          f"while holding {_lock_name(h)}"),
+                )
+
+    # ------------------------------------------------- expression scanning
+    def _scan_exprs(self, fi: FuncInfo, node: ast.AST, held: list[LockId]) -> None:
+        for sub in walk_prune(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if held:
+                sym = self._blocking_symbol(fi, sub, held)
+                if sym is not None:
+                    symbol, rule = sym
+                    if rule == "lock-sqlite-under-lock" and any(
+                        any(h in attr for h in _DB_LOCK_HINTS)
+                        for _, attr in held
+                    ):
+                        continue  # the db's own lock: serializing IS the point
+                    self.findings.append(
+                        Finding(
+                            rule,
+                            fi.rel,
+                            sub.lineno,
+                            f"{symbol} while holding "
+                            f"{', '.join(_lock_name(h) for h in held)} — "
+                            "every contender on the lock waits out this call",
+                            context=f"{fi.short}#{symbol}",
+                        )
+                    )
+                    continue
+            target = self.index.resolve_call(fi, sub)
+            if isinstance(target, FuncInfo):
+                if held and target.may_block:
+                    self.findings.append(
+                        Finding(
+                            "lock-blocking-reach",
+                            fi.rel,
+                            sub.lineno,
+                            f"call {target.short}() may block "
+                            f"({target.block_witness}) while holding "
+                            f"{', '.join(_lock_name(h) for h in held)}",
+                            context=f"{fi.short}#{target.short}",
+                        )
+                    )
+                for lock_id in target.reachable_locks:
+                    for h in held:
+                        if h == lock_id:
+                            if not self._reentrant(h):
+                                self.findings.append(
+                                    Finding(
+                                        "lock-self-deadlock",
+                                        fi.rel,
+                                        sub.lineno,
+                                        f"call {target.short}() re-acquires "
+                                        f"held non-reentrant lock "
+                                        f"{_lock_name(h)}",
+                                        context=f"{fi.short}#{target.short}",
+                                    )
+                                )
+                        else:
+                            self.edges.setdefault(
+                                (h, lock_id),
+                                _Edge(h, lock_id, fi.rel, sub.lineno,
+                                      f"{fi.short} calls {target.short} "
+                                      f"(takes {_lock_name(lock_id)}) while "
+                                      f"holding {_lock_name(h)}"),
+                            )
+            # explicit acquire of another lock: an order edge too
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "acquire"
+                and held
+            ):
+                recv = self._receiver_lock(fi, sub.func.value)
+                if recv is not None:
+                    self._record_acquire(
+                        fi, recv[0], recv[1] in ("rlock", "condition"),
+                        held, sub.lineno,
+                    )
+
+    def _reentrant(self, lock_id: LockId) -> bool:
+        return self.lock_kinds.get(lock_id, "lock") in ("rlock", "condition")
+
+    # ----------------------------------------------------------- guarded-by
+    def _check_guarded_annotations(self) -> None:
+        for ci in self.index.classes.values():
+            for attr, (lock_attr, line) in ci.guarded.items():
+                if ci.canonical_lock(lock_attr) is None:
+                    self.findings.append(
+                        Finding(
+                            "guarded-by-unknown-lock",
+                            ci.rel,
+                            line,
+                            f"field {attr} is annotated guarded-by: "
+                            f"{lock_attr}, but class {ci.name} defines no "
+                            "such lock",
+                            context=f"{ci.name}.{attr}",
+                        )
+                    )
+
+    def check_guarded(self) -> list[Finding]:
+        """Separate sweep: every write to a guarded field must sit inside
+        a ``with <its lock>:`` region. Runs its own region walk so the
+        held-set is known at each write site."""
+        out: list[Finding] = []
+        for fi in self.index.all_functions():
+            ci = fi.cls
+            if ci is None or not ci.guarded:
+                continue
+            name = fi.node.name
+            if name == "__init__" or name.endswith("_locked"):
+                continue
+            self._guard_walk(fi, ci, list(fi.node.body), [], out)
+        return out
+
+    def _guard_walk(
+        self,
+        fi: FuncInfo,
+        ci: ClassInfo,
+        stmts: list[ast.stmt],
+        held: list[LockId],
+        out: list[Finding],
+    ) -> None:
+        def report(stmt_or_expr: ast.AST) -> None:
+            for attr, lineno, desc in self._written_fields(ci, stmt_or_expr):
+                lock_attr, _ = ci.guarded[attr]
+                lock_id = ci.canonical_lock(lock_attr)
+                if lock_id is not None and lock_id not in held:
+                    out.append(
+                        Finding(
+                            "guarded-by-escape",
+                            fi.rel,
+                            lineno,
+                            f"{desc} outside `with self.{lock_attr}:` — the "
+                            f"field is annotated guarded-by: {lock_attr}",
+                            context=f"{fi.short}#{attr}",
+                        )
+                    )
+
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            # compound statements: scan only their HEADER expressions here
+            # — their bodies recurse with the correct held-set (scanning
+            # the whole subtree would re-find properly locked writes)
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in s.items:
+                    report(item.context_expr)
+                    resolved = self.index.lock_for_with_item(fi, item.context_expr)
+                    if resolved is not None:
+                        acquired.append(resolved[0])
+                self._guard_walk(fi, ci, s.body, held + acquired, out)
+            elif isinstance(s, (ast.If, ast.While)):
+                report(s.test)
+                self._guard_walk(fi, ci, s.body, held, out)
+                self._guard_walk(fi, ci, s.orelse, held, out)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                report(s.iter)
+                self._guard_walk(fi, ci, s.body, held, out)
+                self._guard_walk(fi, ci, s.orelse, held, out)
+            elif isinstance(s, ast.Match):
+                report(s.subject)
+                for case in s.cases:
+                    self._guard_walk(fi, ci, case.body, held, out)
+            elif isinstance(s, ast.Try):
+                self._guard_walk(fi, ci, s.body, held, out)
+                for h in s.handlers:
+                    self._guard_walk(fi, ci, h.body, held, out)
+                self._guard_walk(fi, ci, s.orelse, held, out)
+                self._guard_walk(fi, ci, s.finalbody, held, out)
+            else:
+                report(s)
+
+    def _written_fields(
+        self, ci: ClassInfo, stmt: ast.AST
+    ) -> list[tuple[str, int, str]]:
+        """Guarded fields written by ``stmt`` (assignments, del, mutator
+        method calls — including through subscripts: self.x[k].append)."""
+        out: list[tuple[str, int, str]] = []
+
+        def base_attr(node: ast.AST) -> str | None:
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return node.attr
+            return None
+
+        def flatten(t: ast.AST) -> list[ast.AST]:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return [x for e in t.elts for x in flatten(e)]
+            return [t]
+
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = [x for t in stmt.targets for x in flatten(t)]
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for t in targets:
+            attr = base_attr(t)
+            # a PLAIN rebind of self.<attr> is a write; `self.x = ...` with
+            # no subscript replaces the container itself
+            if attr in ci.guarded:
+                out.append((attr, stmt.lineno, f"write to self.{attr}"))
+        for node in walk_prune(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+            ):
+                attr = base_attr(node.func.value)
+                if attr in ci.guarded:
+                    out.append(
+                        (attr, node.lineno,
+                         f"self.{attr}.{node.func.attr}(...)")
+                    )
+        return out
+
+    # --------------------------------------------------------------- cycles
+    def _report_cycles(self) -> None:
+        graph: dict[LockId, set[LockId]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            names = sorted(_lock_name(l) for l in scc)
+            witness = [
+                e for (a, b), e in sorted(
+                    self.edges.items(), key=lambda kv: (kv[1].rel, kv[1].line)
+                )
+                if a in scc and b in scc
+            ]
+            w = witness[0]
+            detail = "; ".join(e.desc for e in witness[:4])
+            self.findings.append(
+                Finding(
+                    "lock-order-cycle",
+                    w.rel,
+                    w.line,
+                    f"lock-order cycle between {', '.join(names)}: {detail} "
+                    "— two threads taking these in opposite orders deadlock",
+                    context="cycle:" + "->".join(names),
+                )
+            )
+
+
+def _sccs(graph: dict[Any, set[Any]]) -> list[set[Any]]:
+    """Tarjan strongly-connected components (iterative)."""
+    idx: dict[Any, int] = {}
+    low: dict[Any, int] = {}
+    on: set[Any] = set()
+    stack: list[Any] = []
+    out: list[set[Any]] = []
+    counter = [0]
+
+    def strong(v: Any) -> None:
+        work = [(v, iter(graph.get(v, ())))]
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == idx[node]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.add(w)
+                    if w == node:
+                        break
+                out.append(comp)
+
+    for v in list(graph):
+        if v not in idx:
+            strong(v)
+    return out
+
+
+def run_lock_pass(index: Index) -> list[Finding]:
+    p = LockPass(index)
+    findings = p.run()
+    findings.extend(p.check_guarded())
+    return findings

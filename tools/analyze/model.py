@@ -1,0 +1,211 @@
+"""v6lint core: finding model, package file walker, waiver baseline.
+
+Every pass produces :class:`Finding` records; the driver partitions them
+against the committed waiver baseline (``tools/analyze/baseline.toml``) so
+pre-existing, *justified* findings never block CI while anything new does.
+
+Waiver keys are deliberately line-number-free: ``rule@path:context`` where
+``context`` is the enclosing function/class qualname (plus a ``#symbol``
+discriminator where one function can host several distinct findings).
+Unrelated edits that shift line numbers must not invalidate the baseline —
+a waiver dies only when the finding it covers disappears (it then shows up
+as *stale* so the baseline can't silently rot).
+
+The baseline is a restricted TOML subset (``[[waiver]]`` tables with
+``key``/``reason`` string values) read and written here without a TOML
+dependency: values are emitted with ``json.dumps``, whose escape set is a
+subset of TOML basic-string escapes, and parsed back with ``json.loads``.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location.
+
+    ``context`` anchors the waiver key to a symbol, not a line — see the
+    module docstring for why.
+    """
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    context: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}@{self.path}:{self.context or '<module>'}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.context}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "context": self.context,
+            "key": self.key,
+        }
+
+
+class SourceFile:
+    """One parsed package file: text, lines and AST, parsed exactly once."""
+
+    def __init__(self, abspath: str, rel: str):
+        self.abspath = abspath
+        self.rel = rel
+        with open(abspath, "r", encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=rel)
+
+
+def walk_package(root: str, subdirs: Iterable[str]) -> list[SourceFile]:
+    """Parse every ``*.py`` under ``root/<subdir>`` (skipping caches).
+
+    A file that fails to parse raises: the analyzer must never silently
+    skip a module — an unparseable file would otherwise exempt itself
+    from every invariant.
+    """
+    files: list[SourceFile] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base) and base.endswith(".py"):
+            files.append(SourceFile(base, os.path.relpath(base, root).replace(os.sep, "/")))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                ap = os.path.join(dirpath, fn)
+                files.append(
+                    SourceFile(ap, os.path.relpath(ap, root).replace(os.sep, "/"))
+                )
+    files.sort(key=lambda f: f.rel)
+    return files
+
+
+# --------------------------------------------------------------- baseline
+_WAIVER_HEADER = re.compile(r"^\s*\[\[waiver\]\]\s*(#.*)?$")
+_KV = re.compile(r"^\s*(key|reason)\s*=\s*(\".*\")\s*(#.*)?$")
+
+
+class BaselineError(ValueError):
+    """The baseline file is malformed — a loud failure, never a silent
+    skip (a truncated baseline would waive nothing and fail CI anyway,
+    but with a misleading flood of 'new' findings)."""
+
+
+def load_baseline(path: str) -> dict[str, str]:
+    """``{waiver key: reason}``. Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    waivers: dict[str, str] = {}
+    current: dict[str, str] | None = None
+
+    def _commit(entry: dict[str, str] | None, lineno: int) -> None:
+        if entry is None:
+            return
+        if "key" not in entry:
+            raise BaselineError(f"{path}:{lineno}: waiver without a key")
+        if not entry.get("reason", "").strip():
+            raise BaselineError(
+                f"{path}:{lineno}: waiver {entry['key']!r} has no reason — "
+                "every baseline entry must carry a written justification"
+            )
+        waivers[entry["key"]] = entry["reason"]
+
+    lineno = 0
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if _WAIVER_HEADER.match(line):
+                _commit(current, lineno)
+                current = {}
+                continue
+            m = _KV.match(raw)
+            if m is None:
+                raise BaselineError(
+                    f"{path}:{lineno}: unparseable baseline line: {line!r}"
+                )
+            if current is None:
+                raise BaselineError(
+                    f"{path}:{lineno}: key/value outside a [[waiver]] table"
+                )
+            try:
+                current[m.group(1)] = json.loads(m.group(2))
+            except json.JSONDecodeError as e:
+                raise BaselineError(
+                    f"{path}:{lineno}: bad string literal: {e}"
+                ) from None
+    _commit(current, lineno)
+    return waivers
+
+
+def save_baseline(path: str, waivers: dict[str, str]) -> None:
+    lines = [
+        "# v6lint waiver baseline — regenerate with "
+        "`python -m tools.analyze --waive`.",
+        "# Every entry must carry a real justification; an unreviewed",
+        "# placeholder reason is a review comment waiting to happen.",
+        "# Keys are line-number-free (rule@path:context), so unrelated",
+        "# edits never invalidate them; stale keys are reported by the",
+        "# analyzer and dropped by --waive.",
+        "",
+    ]
+    for key in sorted(waivers):
+        lines.append("[[waiver]]")
+        lines.append(f"key = {json.dumps(key)}")
+        lines.append(f"reason = {json.dumps(waivers[key])}")
+        lines.append("")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines))
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list[Finding]
+    waived: list[Finding]
+    stale_waivers: list[str]
+
+    @property
+    def unwaived(self) -> list[Finding]:
+        return self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "unwaived": [f.to_dict() for f in self.findings],
+            "waived": [f.to_dict() for f in self.waived],
+            "stale_waivers": list(self.stale_waivers),
+            "counts": {
+                "unwaived": len(self.findings),
+                "waived": len(self.waived),
+                "stale_waivers": len(self.stale_waivers),
+            },
+        }
+
+
+def partition(
+    findings: list[Finding], baseline: dict[str, str]
+) -> AnalysisResult:
+    """Split findings into unwaived/waived and name stale waiver keys."""
+    seen_keys = {f.key for f in findings}
+    unwaived = [f for f in findings if f.key not in baseline]
+    waived = [f for f in findings if f.key in baseline]
+    stale = sorted(k for k in baseline if k not in seen_keys)
+    unwaived.sort(key=lambda f: (f.path, f.line, f.rule))
+    waived.sort(key=lambda f: (f.path, f.line, f.rule))
+    return AnalysisResult(unwaived, waived, stale)

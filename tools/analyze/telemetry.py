@@ -1,0 +1,172 @@
+"""v6lint pass 4 — telemetry coherence.
+
+``common/telemetry.py``'s ``KNOWN_METRICS`` is the declarative metric
+surface: the Prometheus HELP/TYPE source and the table the CI gate audits
+for uniqueness. This pass closes the loop in both directions, on ASTs
+(the table is a pure literal, so no package import — and no jax import —
+is needed):
+
+- ``metric-undeclared``: a ``REGISTRY.counter/gauge/histogram("name")``
+  instantiation, or a ``v6t_``-prefixed string used as a metric name
+  anywhere in the package, that ``KNOWN_METRICS`` does not declare —
+  it would render untyped and undocumented in ``GET /api/metrics``.
+- ``metric-kind-mismatch``: instantiated as one kind, declared as
+  another — the render lies about the series' semantics.
+- ``metric-dead``: declared but never instantiated or emitted anywhere —
+  a dead series that documents telemetry the system does not produce.
+
+Names are matched as whole string constants; dynamically composed names
+(f-strings) are invisible to this pass by design — the declared surface
+is supposed to be literal (that is what makes it auditable).
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import Index
+from .model import Finding
+
+_TELEMETRY_MODULE = "vantage6_tpu.common.telemetry"
+_INSTRUMENT_KINDS = {"counter", "gauge", "histogram"}
+_PREFIX = "v6t_"
+
+
+def _declared_metrics(
+    index: Index,
+) -> tuple[dict[str, str], int, str] | None:
+    """``({name: kind}, table line, rel path)`` parsed from the
+    KNOWN_METRICS literal (None when the telemetry module is not in the
+    analyzed tree — fixture runs)."""
+    mi = index.find_module(_TELEMETRY_MODULE)
+    if mi is None:
+        return None
+    for stmt in mi.src.tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+        if not any(
+            isinstance(t, ast.Name) and t.id == "KNOWN_METRICS" for t in targets
+        ):
+            continue
+        try:
+            entries = ast.literal_eval(stmt.value)
+        except ValueError:
+            return None  # malformed table: check_collect's audit reports it
+        out: dict[str, str] = {}
+        for entry in entries:
+            if isinstance(entry, (tuple, list)) and len(entry) >= 2:
+                out[str(entry[0])] = str(entry[1])
+        return out, stmt.lineno, mi.src.rel
+    return None
+
+
+def run_telemetry_pass(index: Index) -> list[Finding]:
+    parsed = _declared_metrics(index)
+    if parsed is None:
+        return []
+    declared, table_line, table_rel = parsed
+    findings: list[Finding] = []
+    used: set[str] = set()
+
+    for mi in index.modules.values():
+        known_metrics_node = None
+        if mi.src.rel == table_rel:
+            for stmt in mi.src.tree.body:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    tgts = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    if any(
+                        isinstance(t, ast.Name) and t.id == "KNOWN_METRICS"
+                        for t in tgts
+                    ):
+                        known_metrics_node = stmt
+        declaration_ids = (
+            {id(n) for n in ast.walk(known_metrics_node)}
+            if known_metrics_node is not None
+            else set()
+        )
+        # collector dicts: a dict literal carrying at least one DECLARED
+        # metric key is a metric emission map — its undeclared siblings
+        # are drift. A lone "v6t_..." string elsewhere (an env-var
+        # prefix, a thread name) is not a metric and is never flagged.
+        collector_keys: set[int] = set()
+        for node in ast.walk(mi.src.tree):
+            if isinstance(node, ast.Dict) and any(
+                isinstance(k, ast.Constant) and k.value in declared
+                for k in node.keys
+            ):
+                for k in node.keys:
+                    collector_keys.add(id(k))
+        for node in ast.walk(mi.src.tree):
+            if id(node) in declaration_ids:
+                continue  # the declaration itself is not a usage
+            # instrument instantiations: REGISTRY.counter("name") / etc.
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _INSTRUMENT_KINDS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith(_PREFIX)
+            ):
+                name = node.args[0].value
+                kind = node.func.attr
+                used.add(name)
+                if name not in declared:
+                    findings.append(
+                        Finding(
+                            "metric-undeclared", mi.src.rel, node.lineno,
+                            f"REGISTRY.{kind}({name!r}) is not declared in "
+                            "KNOWN_METRICS — it renders untyped in "
+                            "/api/metrics; add it to the table first",
+                            context=name,
+                        )
+                    )
+                elif declared[name] != kind:
+                    findings.append(
+                        Finding(
+                            "metric-kind-mismatch", mi.src.rel, node.lineno,
+                            f"{name} instantiated as {kind} but declared as "
+                            f"{declared[name]} — the exposition TYPE line "
+                            "lies about the series",
+                            context=name,
+                        )
+                    )
+            # any other literal use of a declared/v6t_ name (collector dict
+            # keys, snapshot mappings) counts as an emission site
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith(_PREFIX)
+            ):
+                if node.value in declared:
+                    used.add(node.value)
+                elif id(node) in collector_keys:
+                    findings.append(
+                        Finding(
+                            "metric-undeclared", mi.src.rel, node.lineno,
+                            f"collector emits {node.value!r}, which is not "
+                            "declared in KNOWN_METRICS — it renders untyped "
+                            "in /api/metrics; add it to the table first",
+                            context=node.value,
+                        )
+                    )
+    for name in sorted(set(declared) - used):
+        findings.append(
+            Finding(
+                "metric-dead",
+                table_rel,
+                table_line,
+                f"{name} is declared in KNOWN_METRICS but never "
+                "instantiated or emitted anywhere in the package — a dead "
+                "series documenting telemetry the system does not produce",
+                context=name,
+            )
+        )
+    return findings

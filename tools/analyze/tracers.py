@@ -1,0 +1,370 @@
+"""v6lint pass 2 — JAX tracer hygiene.
+
+Finds code that is *reachable from a traced entry point* (``jax.jit``,
+``shard_map`` / ``station_shard_map`` / ``fed_map``, ``vmap``/``grad``,
+``lax`` control-flow bodies, ``pallas_call`` kernels, ``@device_step``
+partials) and flags operations that silently break under tracing:
+
+- ``tracer-host-sync``: ``.item()`` / ``float(...)`` / ``np.asarray`` /
+  ``np.array`` on what may be a tracer — a forced device->host sync that
+  either crashes (ConcretizationTypeError) or, worse, constant-folds a
+  runtime value into the compiled executable.
+- ``tracer-impure-call``: ``time.*`` / stdlib ``random.*`` /
+  ``np.random.*`` / ``print`` / ``open`` inside traced code — evaluated
+  ONCE at trace time and burned into the executable, not per call
+  (``jax.random`` with an explicit key, and ``jax.debug.print``, are the
+  traced-world equivalents and are not flagged).
+- ``tracer-donated-reuse``: an argument passed to a ``donate_argnums``
+  executable and *read again* afterwards — the buffer was handed to XLA
+  and may already hold the output.
+
+Calls wrapped in ``pure_callback`` / ``io_callback`` / ``debug.callback``
+are exempt: those are the sanctioned host escapes.
+
+Reachability is the indexed call graph's closure, so a helper three calls
+below a jitted entry point is checked too; an unresolvable call simply
+stops propagation (missed findings over false ones).
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import FuncInfo, Index, dotted, walk_prune
+from .model import Finding
+
+# wrapper -> positions of the traced function argument(s)
+_WRAPPER_FN_ARGS: dict[str, tuple[int, ...]] = {
+    "jit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+    "scan": (0,),
+    "map": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2, 3),
+    "switch": (1, 2, 3, 4),
+    "custom_vjp": (0,),
+    "custom_jvp": (0,),
+}
+_JAXISH_HEADS = ("jax", "jnp", "lax", "pl", "pallas")
+
+_SHAPE_HINTS = ("shape", "ndim", "size", "dtype", "len", "range")
+
+
+def _is_jax_wrapper(index: Index, fi: FuncInfo | None, call: ast.Call) -> tuple[int, ...] | None:
+    """Traced-function argument positions when ``call`` wraps its argument
+    in a tracer (None otherwise)."""
+    chain = dotted(call.func)
+    if chain is None or ".tree" in chain:
+        return None  # jax.tree.map runs its fn EAGERLY — not a tracer
+    leaf = chain.rsplit(".", 1)[-1]
+    if leaf == "fed_map":  # method call: mesh.fed_map(fn, ...)
+        return (0,)
+    if leaf == "station_shard_map":  # station_shard_map(mesh, fn, ...)
+        return (1,)
+    if leaf == "device_step":
+        return (0,)
+    positions = _WRAPPER_FN_ARGS.get(leaf)
+    if positions is None:
+        return None
+    head = chain.split(".", 1)[0]
+    if head in _JAXISH_HEADS or leaf in ("shard_map", "pallas_call", "jit"):
+        return positions
+    # resolve bare/aliased names through imports (from jax import jit)
+    if fi is not None:
+        mi = index.modules[fi.module]
+        resolved = mi.resolve_name(chain)
+        if resolved is not None and resolved.split(".", 1)[0] == "jax":
+            return positions
+    return None
+
+
+class TracerPass:
+    def __init__(self, index: Index):
+        self.index = index
+        self.findings: list[Finding] = []
+
+    def run(self) -> list[Finding]:
+        traced = self._traced_closure()
+        for fi in traced:
+            self._check_body(fi)
+        for fi in self.index.all_functions():
+            self._check_donated_reuse(fi)
+        return self.findings
+
+    # -------------------------------------------------------- reachability
+    def _traced_closure(self) -> list[FuncInfo]:
+        roots: set[str] = set()
+        lambda_hosts: list[tuple[FuncInfo, ast.Lambda]] = []
+        for fi in self.index.all_functions():
+            # decorators: @jax.jit / @partial(jax.jit, ...) / @device_step
+            for deco in getattr(fi.node, "decorator_list", []):
+                name = dotted(deco if not isinstance(deco, ast.Call) else deco.func)
+                if name is None:
+                    continue
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf in ("jit", "device_step", "vmap", "grad", "checkpoint",
+                            "remat", "custom_vjp", "custom_jvp"):
+                    roots.add(fi.qualname)
+                elif leaf == "partial" and isinstance(deco, ast.Call):
+                    for arg in deco.args[:1]:
+                        inner = dotted(arg)
+                        if inner and inner.rsplit(".", 1)[-1] == "jit":
+                            roots.add(fi.qualname)
+            for call in (
+                n for n in walk_prune(fi.node) if isinstance(n, ast.Call)
+            ):
+                positions = _is_jax_wrapper(self.index, fi, call)
+                if positions is None:
+                    continue
+                for pos in positions:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if isinstance(arg, ast.Lambda):
+                        lambda_hosts.append((fi, arg))
+                        continue
+                    target = self._resolve_ref(fi, arg)
+                    if target is not None:
+                        roots.add(target.qualname)
+        # closure over the call graph
+        seen: set[str] = set()
+        work = sorted(roots)
+        while work:
+            q = work.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fi = self.index.functions.get(q)
+            if fi is None:
+                continue
+            work.extend(fi.callees - seen)
+        # lambdas traced inline: their resolved callees join the closure,
+        # and their own bodies are checked in the host function's context
+        for host, lam in lambda_hosts:
+            self._check_exprs(host, lam.body, note=" (in traced lambda)")
+            for call in ast.walk(lam):
+                if isinstance(call, ast.Call):
+                    target = self.index.resolve_call(host, call)
+                    if isinstance(target, FuncInfo) and target.qualname not in seen:
+                        work = [target.qualname]
+                        while work:
+                            q = work.pop()
+                            if q in seen:
+                                continue
+                            seen.add(q)
+                            t = self.index.functions.get(q)
+                            if t is not None:
+                                work.extend(t.callees - seen)
+        return [self.index.functions[q] for q in sorted(seen) if q in self.index.functions]
+
+    def _resolve_ref(self, fi: FuncInfo, expr: ast.AST) -> FuncInfo | None:
+        fake = ast.Call(func=expr, args=[], keywords=[])
+        target = self.index.resolve_call(fi, fake)
+        return target if isinstance(target, FuncInfo) else None
+
+    # ------------------------------------------------------------- checking
+    def _check_body(self, fi: FuncInfo) -> None:
+        self._check_exprs(fi, fi.node)
+
+    def _check_exprs(self, fi: FuncInfo, node: ast.AST, note: str = "") -> None:
+        exempt = self._callback_descendants(node)
+        for sub in walk_prune(node):
+            if not isinstance(sub, ast.Call) or id(sub) in exempt:
+                continue
+            self._check_call(fi, sub, note)
+
+    def _callback_descendants(self, node: ast.AST) -> set[int]:
+        """ids of nodes inside sanctioned host-escape wrappers."""
+        out: set[int] = set()
+        for sub in walk_prune(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            chain = dotted(sub.func)
+            leaf = chain.rsplit(".", 1)[-1] if chain else ""
+            if leaf in ("pure_callback", "io_callback", "callback"):
+                for inner in ast.walk(sub):
+                    out.add(id(inner))
+        return out
+
+    def _check_call(self, fi: FuncInfo, call: ast.Call, note: str) -> None:
+        func = call.func
+        ctx = fi.short
+        # .item(): the canonical device->host sync
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "item"
+            and not call.args
+        ):
+            self.findings.append(
+                Finding(
+                    "tracer-host-sync", fi.rel, call.lineno,
+                    ".item() in traced code forces a device->host sync "
+                    "(ConcretizationTypeError under jit)" + note,
+                    context=f"{ctx}#item",
+                )
+            )
+            return
+        chain = dotted(func)
+        resolved = None
+        if chain is not None:
+            resolved = self.index.modules[fi.module].resolve_name(chain) or chain
+        if chain is not None:
+            head = resolved.split(".", 1)[0]
+            leaf = chain.rsplit(".", 1)[-1]
+            if head == "numpy" and leaf in ("asarray", "array"):
+                if not all(isinstance(a, ast.Constant) for a in call.args):
+                    self.findings.append(
+                        Finding(
+                            "tracer-host-sync", fi.rel, call.lineno,
+                            f"np.{leaf}(...) on a traced value materializes "
+                            "it on host (use jnp instead)" + note,
+                            context=f"{ctx}#np.{leaf}",
+                        )
+                    )
+                return
+            if resolved.startswith("numpy.random."):
+                self.findings.append(
+                    Finding(
+                        "tracer-impure-call", fi.rel, call.lineno,
+                        f"{chain}(...) in traced code is evaluated once at "
+                        "trace time, not per call — use jax.random with an "
+                        "explicit key" + note,
+                        context=f"{ctx}#{chain}",
+                    )
+                )
+                return
+            if head in ("time", "datetime") and "." in resolved:
+                self.findings.append(
+                    Finding(
+                        "tracer-impure-call", fi.rel, call.lineno,
+                        f"{chain}(...) in traced code is burned in at trace "
+                        "time — a compiled executable never re-reads the "
+                        "clock" + note,
+                        context=f"{ctx}#{chain}",
+                    )
+                )
+                return
+            if head == "random" and resolved.split(".", 1)[0] == "random":
+                self.findings.append(
+                    Finding(
+                        "tracer-impure-call", fi.rel, call.lineno,
+                        f"stdlib {chain}(...) in traced code — impure and "
+                        "trace-time-frozen; use jax.random with a key" + note,
+                        context=f"{ctx}#{chain}",
+                    )
+                )
+                return
+        if isinstance(func, ast.Name):
+            if func.id == "float" and call.args and not self._static_arg(call.args[0]):
+                self.findings.append(
+                    Finding(
+                        "tracer-host-sync", fi.rel, call.lineno,
+                        "float(...) on a traced value forces a host sync "
+                        "(jnp.asarray / astype keep it on device)" + note,
+                        context=f"{ctx}#float",
+                    )
+                )
+            elif func.id in ("print", "open", "input"):
+                self.findings.append(
+                    Finding(
+                        "tracer-impure-call", fi.rel, call.lineno,
+                        f"{func.id}(...) in traced code runs at trace time "
+                        "only (jax.debug.print is the traced equivalent)"
+                        + note,
+                        context=f"{ctx}#{func.id}",
+                    )
+                )
+
+    @staticmethod
+    def _static_arg(arg: ast.AST) -> bool:
+        """Shape arithmetic and literals are trace-static: float(x.shape[0])
+        is legal under jit and must not be flagged."""
+        if isinstance(arg, ast.Constant):
+            return True
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Attribute) and node.attr in _SHAPE_HINTS:
+                return True
+            if isinstance(node, ast.Call):
+                chain = dotted(node.func)
+                if chain in ("len", "range"):
+                    return True
+        return False
+
+    # ------------------------------------------------------- donated reuse
+    def _check_donated_reuse(self, fi: FuncInfo) -> None:
+        """Linear scan of a function body: a name passed in a donated
+        position of a locally-built ``jax.jit(..., donate_argnums=...)``
+        executable is poisoned until rebound; reading it afterwards is a
+        use of a buffer XLA may already have overwritten."""
+        donors: dict[str, tuple[int, ...]] = {}
+        poisoned: dict[str, int] = {}  # name -> donation line
+        for stmt in fi.node.body:
+            # 1) reads of poisoned names in this statement?
+            for node in walk_prune(stmt):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in poisoned
+                ):
+                    self.findings.append(
+                        Finding(
+                            "tracer-donated-reuse", fi.rel, node.lineno,
+                            f"{node.id} was donated to a jit executable at "
+                            f"line {poisoned[node.id]} and read again — the "
+                            "buffer may already hold the output",
+                            context=f"{fi.short}#{node.id}",
+                        )
+                    )
+                    poisoned.pop(node.id, None)  # one finding per donation
+            # 2) new donor definitions / donated calls / rebinds
+            if isinstance(stmt, ast.Assign):
+                targets = [
+                    t.id for t in stmt.targets if isinstance(t, ast.Name)
+                ]
+                donate = self._jit_donate_positions(stmt.value)
+                if donate is not None and targets:
+                    for t in targets:
+                        donors[t] = donate
+                elif (
+                    isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Name)
+                    and stmt.value.func.id in donors
+                ):
+                    for pos in donors[stmt.value.func.id]:
+                        if pos < len(stmt.value.args) and isinstance(
+                            stmt.value.args[pos], ast.Name
+                        ):
+                            name = stmt.value.args[pos].id
+                            if name not in targets:
+                                poisoned[name] = stmt.lineno
+                for t in targets:  # rebinding un-poisons
+                    poisoned.pop(t, None)
+
+    def _jit_donate_positions(self, value: ast.AST) -> tuple[int, ...] | None:
+        if not isinstance(value, ast.Call):
+            return None
+        chain = dotted(value.func)
+        if chain is None or chain.rsplit(".", 1)[-1] != "jit":
+            return None
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                try:
+                    positions = ast.literal_eval(kw.value)
+                except ValueError:
+                    return None
+                if isinstance(positions, int):
+                    return (positions,)
+                if isinstance(positions, (tuple, list)):
+                    return tuple(int(p) for p in positions)
+        return None
+
+
+def run_tracer_pass(index: Index) -> list[Finding]:
+    return TracerPass(index).run()

@@ -22,21 +22,31 @@ long-poll event channel and observability pair (`run/claim-batch`,
 daemon/client call sites that depend on them. A rename on either side
 silently degrades every "new" daemon to the per-run fallback forever — this
 gate turns that silent drift into a loud failure before any test runs.
+(The audit is AST-backed since the v6lint analyzer landed: routes are read
+from the real `@app.route` decorators and references from real string
+constants, via `tools.analyze.contracts` — no more substring matching.)
 
 It ALSO audits the TELEMETRY registry's declared metric surface
 (`common/telemetry.py` KNOWN_METRICS): every name unique, snake_case, and
 typed — a duplicate would silently shadow a series in `GET /api/metrics`.
 
+It ALSO runs the full v6lint static analyzer (`python -m tools.analyze
+--json`, docs/static_analysis.md): lock discipline, JAX tracer hygiene,
+route/method contracts and telemetry coherence over the whole package.
+Any finding not waived (with a reason) in tools/analyze/baseline.toml
+fails here before any test runs.
+
 Usage:
     python tools/check_collect.py [pytest target, default: tests/]
 
 Exit codes: 0 = clean collection + wire compat + route audit + telemetry
-audit; 1 = collection errors, a golden blob stopped decoding, a route
-drifted, or a metric name failed the audit (details printed); 2 = pytest
-itself could not run.
+audit + static analysis; 1 = collection errors, a golden blob stopped
+decoding, a route drifted, a metric name failed the audit, or an unwaived
+analyzer finding (details printed); 2 = pytest itself could not run.
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import subprocess
@@ -46,7 +56,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # endpoint (as referenced by clients, no /api/ prefix) -> the call-site
 # files that must mention it. Kept literal on purpose: the audit is about
-# agreement between fixed strings on both sides of the wire.
+# agreement between fixed strings on both sides of the wire. The MAP is CI
+# policy and lives here; the AST mechanics live in tools.analyze.contracts.
 _ROUTE_AUDIT: dict[str, list[str]] = {
     "run/claim-batch": ["vantage6_tpu/node/daemon.py"],
     "run/batch": ["vantage6_tpu/node/daemon.py"],
@@ -69,35 +80,59 @@ _ROUTE_AUDIT: dict[str, list[str]] = {
 def check_control_plane_routes() -> list[str]:
     """Static audit: every batched/long-poll endpoint exists as a server
     route AND is referenced by its expected call sites. Returns failure
-    descriptions (empty = no drift)."""
-    problems: list[str] = []
-    res_path = os.path.join(
-        _REPO_ROOT, "vantage6_tpu", "server", "resources.py"
-    )
+    descriptions (empty = no drift). AST-backed via the v6lint contract
+    pass — decorator route tables and real string constants, not regex."""
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
     try:
-        resources_src = open(res_path).read()
-    except OSError as e:
-        return [f"cannot read {res_path}: {e}"]
-    routes = set(re.findall(r'@app\.route\("([^"]+)"', resources_src))
-    for endpoint, call_sites in _ROUTE_AUDIT.items():
-        if f"/api/{endpoint}" not in routes:
-            problems.append(
-                f"server route /api/{endpoint} is gone from "
-                "server/resources.py but daemons/clients still call it"
-            )
-        for rel in call_sites:
-            path = os.path.join(_REPO_ROOT, rel)
-            try:
-                src = open(path).read()
-            except OSError as e:
-                problems.append(f"{rel}: call-site file unreadable ({e})")
-                continue
-            if f'"{endpoint}"' not in src:
-                problems.append(
-                    f"{rel} no longer references endpoint {endpoint!r} — "
-                    "either the fast path was removed (update this audit) "
-                    "or the call site drifted from the route name"
-                )
+        from tools.analyze import audit_critical_routes, build_index
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import the v6lint contract pass: {e!r}"]
+    try:
+        # light: the audit needs route tables + string constants only,
+        # not the call-graph fixpoints (the full analyzer runs separately
+        # as its own gate)
+        index = build_index(_REPO_ROOT, light=True)
+    except Exception as e:
+        return [f"cannot parse the package for the route audit: {e!r}"]
+    return audit_critical_routes(index, _ROUTE_AUDIT)
+
+
+def check_static_analysis() -> list[str]:
+    """Run the full v6lint analyzer as a subprocess (`python -m
+    tools.analyze --json`) and report every unwaived finding plus stale
+    waivers' housekeeping. A separate process keeps the gate honest: it
+    runs exactly what CI and developers run."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--json"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    if proc.returncode not in (0, 1):
+        return [
+            f"analyzer crashed (rc={proc.returncode}): "
+            + (proc.stderr or proc.stdout)[-1500:]
+        ]
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return [f"analyzer emitted unparseable JSON: {proc.stdout[-500:]!r}"]
+    problems = [
+        f"{f['path']}:{f['line']}: {f['rule']} [{f['context']}] {f['message']}"
+        for f in report.get("unwaived", [])
+    ]
+    if proc.returncode == 1 and not problems:
+        problems.append("analyzer exited 1 without findings (malformed baseline?)"
+                        + (": " + proc.stderr.strip() if proc.stderr else ""))
+    for key in report.get("stale_waivers", []):
+        # housekeeping, printed but not fatal: a stale waiver means a
+        # finding was FIXED — celebrate, then prune the baseline
+        sys.stderr.write(f"  note: stale waiver (prune from baseline): {key}\n")
+    seconds = report.get("seconds")
+    if isinstance(seconds, (int, float)) and seconds > 10:
+        problems.append(
+            f"analyzer took {seconds:.1f}s — over the 10s CI budget "
+            "(docs/static_analysis.md)"
+        )
     return problems
 
 
@@ -223,6 +258,17 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    lint_problems = check_static_analysis()
+    if lint_problems:
+        sys.stderr.write(
+            "STATIC ANALYSIS FAILED: unwaived v6lint finding(s) — fix them "
+            "or waive with a written reason in tools/analyze/baseline.toml "
+            "(docs/static_analysis.md):\n"
+        )
+        for p in lint_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     target = argv[1:] or ["tests/"]
     cmd = [
         sys.executable, "-m", "pytest", *target,
@@ -261,6 +307,7 @@ def main(argv: list[str]) -> int:
         print("route audit ok: batched control-plane + observability "
               "endpoints match their call sites")
         print("telemetry audit ok: metric names unique and snake_case")
+        print("static analysis ok: v6lint found no unwaived violations")
         print(f"collection clean: {counted} tests collected")
         return 0
     if n_errors == 0:

@@ -98,7 +98,7 @@ class _SessionPool:
     def __init__(self, max_idle: int = 8):
         self.max_idle = max_idle
         self._lock = threading.Lock()
-        self._idle: dict[str, list[requests.Session]] = {}
+        self._idle: dict[str, list[requests.Session]] = {}  # guarded-by: _lock
 
     @staticmethod
     def _key(url: str) -> str:

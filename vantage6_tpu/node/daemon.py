@@ -253,7 +253,7 @@ class NodeDaemon:
         # run_id -> claim-batch entry (run dict + embedded task +
         # container token): what a batched claim prefetched so _execute
         # skips its per-run GET run / GET task / POST token round-trips
-        self._prefetched: dict[int, dict[str, Any]] = {}
+        self._prefetched: dict[int, dict[str, Any]] = {}  # guarded-by: _claim_lock
         self._access_token: str | None = None
         self._refresh_token: str | None = None
         self._rest = RestSession(
@@ -271,7 +271,7 @@ class NodeDaemon:
         self._pool = ThreadPoolExecutor(
             max_workers=max_concurrent_runs, thread_name_prefix="v6t-run"
         )
-        self._claimed: set[int] = set()
+        self._claimed: set[int] = set()  # guarded-by: _claim_lock
         self._claim_lock = threading.Lock()
         # one sweep at a time: the sync worker and a post-restart resync
         # must not interleave their claim-check -> PATCH windows

@@ -55,16 +55,17 @@ class StationExecutor:
         self.n_stations = n_stations
         self.workers = workers
         self._cond = threading.Condition()
+        # guarded-by: _cond
         self._queues: list[deque[Callable[[], Any]]] = [
             deque() for _ in range(n_stations)
         ]
         # thread currently executing (or holding, while blocked in a nested
         # wait) each station; None = idle
-        self._executing: list[threading.Thread | None] = [None] * n_stations
-        self._inflight = 0
-        self._rr = 0  # round-robin claim start: no station starves
+        self._executing: list[threading.Thread | None] = [None] * n_stations  # guarded-by: _cond
+        self._inflight = 0  # guarded-by: _cond
+        self._rr = 0  # guarded-by: _cond (round-robin claim start)
         self._tls = threading.local()
-        self._shutdown = False
+        self._shutdown = False  # guarded-by: _cond
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="v6t-station"
         )

@@ -87,19 +87,21 @@ class Federation:
         ]
         # org RSA identity keys (advert signing, secureagg_dh): generated
         # LAZILY — RSA keygen costs seconds and most workloads never sign
-        self._identity_cryptors: list[Any] = [None] * config.n_stations
+        self._identity_cryptors: list[Any] = [None] * config.n_stations  # guarded-by: _identity_lock
         # station data: per-station {label: dataset}; device-mode stacked
         # arrays cached per label.
         self._data: list[dict[str, Any]] = [{} for _ in self.stations]
         # sessions (reference v4.7): per-station in-memory dataframe stores,
         # keyed session id -> {handle: DataFrame} — the simulator analogue
-        # of each node's local pickle store
-        self._sessions: dict[int, dict[str, Any]] = {}
+        # of each node's local pickle store. Session BOOKKEEPING is shared
+        # between the user thread (create/delete) and pool workers
+        # (store_as finishes).
+        self._sessions: dict[int, dict[str, Any]] = {}  # guarded-by: _session_lock
         self._session_stores: list[dict[int, dict[str, Any]]] = [
             {} for _ in self.stations
         ]
         self._session_ids = iter(range(1, 10**9))
-        self._stacked_cache: dict[str, Any] = {}
+        self._stacked_cache: dict[str, Any] = {}  # guarded-by: _stacked_lock
         self._algorithms: dict[str, dict[str, Callable]] = {}
         for image, mod in (algorithms or {}).items():
             self.register_algorithm(image, mod)
@@ -124,7 +126,7 @@ class Federation:
             )
         # run ids queued/executing on the pool (NOT the same as PENDING:
         # a PENDING run on an offline station is owed, not in flight)
-        self._inflight_runs: set[int] = set()
+        self._inflight_runs: set[int] = set()  # guarded-by: _inflight_lock
         self._inflight_lock = threading.Lock()
         self._stacked_lock = threading.Lock()   # _stacked_cache builds
         self._identity_lock = threading.Lock()  # lazy RSA keygen
@@ -136,7 +138,10 @@ class Federation:
         for i, scfg in enumerate(self.config.stations):
             for db in scfg.databases:
                 self._data[i][db.label] = load_data(db)
-        self._stacked_cache.clear()
+        # under the lock: a pooled device run could be building a stacked
+        # entry from the OLD data concurrently; clear must not interleave
+        with self._stacked_lock:
+            self._stacked_cache.clear()
 
     def set_datasets(self, label: str, datasets: list[Any]) -> None:
         """Programmatically supply one dataset per station (mock-style)."""
@@ -146,7 +151,8 @@ class Federation:
             )
         for i, d in enumerate(datasets):
             self._data[i][label] = d
-        self._stacked_cache.pop(label, None)
+        with self._stacked_lock:
+            self._stacked_cache.pop(label, None)
 
     def station_data(self, station: int, label: str = "default") -> Any:
         if label not in self._data[station]:
@@ -233,7 +239,8 @@ class Federation:
         """A workspace whose named dataframes persist at each station
         between tasks (reference v4.7 'sessions'); returns its id."""
         sid = next(self._session_ids)
-        self._sessions[sid] = {"name": name, "dataframes": {}}
+        with self._session_lock:
+            self._sessions[sid] = {"name": name, "dataframes": {}}
         return sid
 
     def session_dataframes(self, session_id: int) -> dict[str, Any]:
@@ -241,9 +248,14 @@ class Federation:
         return dict(self._sessions[session_id]["dataframes"])
 
     def delete_session(self, session_id: int) -> None:
-        self._sessions.pop(session_id, None)
-        for store in self._session_stores:
-            store.pop(session_id, None)
+        # one locked region for bookkeeping AND stores: a store_as run
+        # finishing concurrently inserts its dataframe under this same
+        # lock only while the session still exists, so the cleanup below
+        # can never race a re-insert (which would leak the dataframe)
+        with self._session_lock:
+            self._sessions.pop(session_id, None)
+            for store in self._session_stores:
+                store.pop(session_id, None)
 
     def create_task(
         self,
@@ -316,10 +328,13 @@ class Federation:
             store_as=store_as,
         )
         if store_as is not None:
-            self._sessions[session]["dataframes"][store_as] = {
-                "ready": False,
-                "columns": [],
-            }
+            # a pool worker finishing a concurrent store_as run mutates the
+            # same bookkeeping dict from _refresh_session_ready
+            with self._session_lock:
+                self._sessions[session]["dataframes"][store_as] = {
+                    "ready": False,
+                    "columns": [],
+                }
         # on-wire input size (estimated v2 frame bytes, metadata-only walk —
         # no device transfer, no actual encode): one measurement shared by
         # every run, the same way a v2 broadcast shares one ciphertext
@@ -576,11 +591,6 @@ class Federation:
                 f"task stores dataframe {task.store_as!r} but the algorithm"
                 f" returned {type(result).__name__}, not a DataFrame"
             )
-        # the dataframe store itself is per-station (executor serializes the
-        # station), but the session BOOKKEEPING is shared across stations
-        self._session_stores[run.station_index].setdefault(
-            task.session_id, {}
-        )[task.store_as] = df
         meta = {
             "stored": task.store_as,
             "session_id": task.session_id,
@@ -590,9 +600,21 @@ class Federation:
                 for c, t in df.dtypes.items()
             ],
         }
+        # store + bookkeeping in ONE locked region, gated on the session
+        # still existing: a delete_session racing this finish must neither
+        # crash a successfully-computed run (KeyError on the popped
+        # bookkeeping — same deleted-mid-run tolerance as
+        # _refresh_session_ready) nor see the dataframe re-inserted after
+        # its cleanup (an orphaned-store leak)
         with self._session_lock:
-            book = self._sessions[task.session_id]["dataframes"][task.store_as]
-            book["columns"] = meta["columns"]
+            session = self._sessions.get(task.session_id)
+            if session is not None:
+                self._session_stores[run.station_index].setdefault(
+                    task.session_id, {}
+                )[task.store_as] = df
+                book = session["dataframes"].get(task.store_as)
+                if book is not None:
+                    book["columns"] = meta["columns"]
         return meta
 
     def _refresh_session_ready(self, task: Task) -> None:

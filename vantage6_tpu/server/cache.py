@@ -35,9 +35,9 @@ class AuthCache:
         self.ttl = ttl
         self.maxsize = maxsize
         self._lock = threading.Lock()
-        self._entries: dict[str, tuple[float, str, Any]] = {}
-        self.hits = 0
-        self.misses = 0
+        self._entries: dict[str, tuple[float, str, Any]] = {}  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     def get(self, token: str) -> tuple[str, Any] | None:
         now = time.monotonic()
@@ -94,11 +94,11 @@ class VisibilityCache:
     def __init__(self, ttl: float = 30.0):
         self.ttl = ttl
         self._lock = threading.Lock()
-        self._entries: dict[int, tuple[float, frozenset[int]]] = {}
+        self._entries: dict[int, tuple[float, frozenset[int]]] = {}  # guarded-by: _lock
         # hit/miss accounting for the unified telemetry registry — the
         # same observability the AuthCache already had
-        self.hits = 0
-        self.misses = 0
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     def get(self, org_id: int) -> frozenset[int] | None:
         now = time.monotonic()

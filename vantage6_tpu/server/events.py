@@ -62,14 +62,14 @@ class EventHub:
 
     def __init__(self, buffer_size: int = 4096):
         self.buffer_size = buffer_size
-        self._buffer: deque[Event] = deque(maxlen=buffer_size)
+        self._buffer: deque[Event] = deque(maxlen=buffer_size)  # guarded-by: _lock
         self._seq = itertools.count(1)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         # seq of the newest event the bounded buffer has DROPPED (0: none)
-        self._evicted_through = 0
+        self._evicted_through = 0  # guarded-by: _lock
         # subscriber id -> (rooms | None for all, callback)
-        self._subs: dict[int, tuple[set[str] | None, Callable[[Event], None]]] = {}
+        self._subs: dict[int, tuple[set[str] | None, Callable[[Event], None]]] = {}  # guarded-by: _lock
         self._sub_ids = itertools.count(1)
 
     # ------------------------------------------------------------------ emit
