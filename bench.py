@@ -135,6 +135,18 @@ WIRE_TIMEOUT_S = 300
 WIRE_MB_SIZES = (1, 10, 32)   # pytree payload sizes (MiB of f32 weights)
 WIRE_REPS = 3                 # timed reps per measurement (median-free mean)
 WIRE_BROADCAST_N = 8          # acceptance: broadcast-to-8 within 2x single
+# compression leg (gradient-compression PR, the wire leg's extension):
+# dense vs compressed (stochastic int8 + top-k + error feedback) delta
+# exchange on the FedAvg-CNN run — the acceptance numbers are >=4x on-wire
+# delta reduction at accuracy parity, with the jitted compress/decompress
+# cost (device.compress spans) under 10% of round time. Sized like the
+# agg_modes leg: small local compute, the DELTA EXCHANGE is the subject.
+COMPRESS_TIMEOUT_S = 600
+COMPRESS_STATIONS = 8
+COMPRESS_ROUNDS = 3
+COMPRESS_TOPK = 0.1           # keep 10% of coordinates
+COMPRESS_ACC_TOL = 0.08       # same rationale as ACC_TOLERANCE_DEGRADED
+COMPRESS_COST_PCT = 10.0      # device.compress budget vs round time
 HOST_STATIONS = 4
 HOST_ROUNDS = 6
 HOST_PAD_S = 0.05
@@ -1375,6 +1387,158 @@ def worker_wireformat() -> None:
     }))
 
 
+def worker_compression() -> None:
+    """compression leg (wire-leg extension, gradient-compression PR).
+
+    The SAME FedAvg-CNN federation trains twice from one init: dense delta
+    exchange vs the compressed stack (stochastic int8 + top-k(COMPRESS_TOPK)
+    + per-station error feedback, docs/compression.md). Reports, per arm:
+    rounds/sec, final accuracy on the shared held-out set, and — the
+    acceptance numbers — the on-wire delta bytes/round (dense 4N f32 per
+    station vs the compressed frame), the reduction ratio (>=4x bar), the
+    accuracy gap (parity within COMPRESS_ACC_TOL), and a compression-cost
+    probe: the SAME jitted compress/decompress kernels run standalone
+    under ``device.compress``/``device.decompress`` trace spans, their
+    total time compared against the measured round time (<10% bar).
+    The probe executes one full round's exchange (S compress + 1
+    decompress) SEQUENTIALLY on the host — an upper bound: on a pod each
+    station's compress runs on its own device concurrently.
+    """
+    jax = _worker_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vantage6_tpu.core.mesh import FederationMesh
+    from vantage6_tpu.fed import compression as comp
+    from vantage6_tpu.fed.collectives import flat_size
+    from vantage6_tpu.fed.compression import CompressorSpec
+    from vantage6_tpu.runtime.tracing import TRACER, summarize
+    from vantage6_tpu.workloads import fedavg_mnist as W
+
+    n_st = int(os.environ.get("BENCH_COMPRESS_STATIONS",
+                              str(COMPRESS_STATIONS)))
+    rounds = int(os.environ.get("BENCH_COMPRESS_ROUNDS",
+                                str(COMPRESS_ROUNDS)))
+    topk = float(os.environ.get("BENCH_COMPRESS_TOPK", str(COMPRESS_TOPK)))
+    # TPU runs afford the headline training config (meaningful accuracy,
+    # ~0.8 at 5 rounds); the CPU fallback shrinks local compute like the
+    # other degraded legs — both arms shrink together, so the reduction
+    # ratio and the parity comparison stay apples-to-apples (calibrated:
+    # the CPU config lands ~0.13 accuracy / gap ~0.014, the subject here
+    # is the DELTA EXCHANGE, measured identically at any config).
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        local_steps, batch, n_per = LOCAL_STEPS, BATCH, N_PER_STATION
+        rounds = int(os.environ.get("BENCH_COMPRESS_ROUNDS",
+                                    str(SPMD_ROUNDS)))
+    else:
+        local_steps, batch, n_per = 2, 8, 64
+    mesh = FederationMesh(n_st)
+    sx, sy, counts = W.make_federated_data(
+        n_st, n_per_station=n_per, mesh=mesh, noise=SYNTH_NOISE
+    )
+    key = jax.random.key(0)
+    p0 = W.init_params(jax.random.fold_in(key, 1))
+    mask = jnp.ones_like(counts)
+    n_params = flat_size(p0)
+    spec = CompressorSpec(topk_ratio=topk, int8=True)
+    ex, ey = _eval_data()
+
+    per_arm: dict = {}
+    for name, compressor in (("dense", None), ("compressed", spec)):
+        eng = W.make_engine(
+            mesh, local_steps=local_steps, batch_size=batch, local_lr=LR,
+            compressor=compressor,
+        )
+        opt0 = eng.init(p0)
+        args = (p0, opt0, sx, sy, counts, mask, key)
+        t0 = time.perf_counter()
+        compiled = eng._run.lower(*args, n_rounds=rounds).compile()
+        compile_s = time.perf_counter() - t0
+        p1, o1, losses = compiled(*args)  # warm (deterministic on args)
+        jax.block_until_ready(losses)
+
+        def step(state, i):
+            p, o = state
+            p, o, ls = compiled(
+                p, o, sx, sy, counts, mask, jax.random.fold_in(key, 50 + i)
+            )
+            return (p, o), ls
+
+        _, times = _timed_chain(jax, step, (p1, o1))
+        dt = _median(times)
+        per_arm[name] = {
+            "rounds_per_sec": round(rounds / dt, 3),
+            "round_time_ms": round(1e3 * dt / rounds, 3),
+            "run_times_s": [round(t, 4) for t in times],
+            "compile_seconds": round(compile_s, 1),
+            "final_loss": float(losses[-1]),
+            # both arms score the ROUND-rounds-deep warm-run model on the
+            # same held-out set — the accuracy-parity comparison
+            "accuracy": round(W.evaluate(p1, ex, ey), 4),
+        }
+
+    # ---- on-wire delta accounting (static, metadata-only) -------------
+    raw_per_round = 4 * n_params * n_st
+    wire_per_round = spec.wire_nbytes(n_params) * n_st
+    reduction = raw_per_round / wire_per_round
+
+    # ---- compression-cost probe (device.compress spans) ---------------
+    rng = np.random.default_rng(5)
+    delta = jnp.asarray(rng.normal(size=n_params).astype(np.float32))
+    ef = jnp.zeros(n_params)
+    # warm the standalone jit executables OUTSIDE the traced probe
+    payload, _, _ = comp.compress_delta(spec, delta, ef,
+                                        key=jax.random.key(0))
+    comp.decompress_delta(spec, payload, n_params)
+    with TRACER.span("bench.compress_probe", kind="bench") as root:
+        for s in range(n_st):
+            payload, _, _ = comp.compress_delta(
+                spec, delta, ef, key=jax.random.key(s), station=s
+            )
+        comp.decompress_delta(spec, payload, n_params)
+        trace_id = root.context.trace_id
+    spans = TRACER.drain(trace_id)
+    table = summarize(spans)["spans"]
+    probe_ms = (
+        table.get("device.compress", {}).get("total_ms", 0.0)
+        + table.get("device.decompress", {}).get("total_ms", 0.0)
+    )
+    round_ms = per_arm["compressed"]["round_time_ms"]
+    cost_pct = round(100.0 * probe_ms / round_ms, 2) if round_ms else None
+
+    gap = abs(per_arm["dense"]["accuracy"]
+              - per_arm["compressed"]["accuracy"])
+    print(json.dumps({
+        "n_stations": n_st,
+        "rounds_per_exec": rounds,
+        "n_params": n_params,
+        "config": {"local_steps": local_steps, "batch": batch,
+                   "n_per_station": n_per},
+        "spec": {"topk_ratio": topk, "int8": True, "chunk": spec.chunk},
+        "arms": per_arm,
+        "delta_raw_bytes_per_round": raw_per_round,
+        "delta_wire_bytes_per_round": wire_per_round,
+        "on_wire_reduction": round(reduction, 2),
+        "reduction_ok": bool(reduction >= 4.0),
+        "accuracy_gap": round(gap, 4),
+        "accuracy_tolerance": COMPRESS_ACC_TOL,
+        "accuracy_parity": bool(gap <= COMPRESS_ACC_TOL),
+        "compress_probe": {
+            "device_compress": table.get("device.compress"),
+            "device_decompress": table.get("device.decompress"),
+            "probe_total_ms": round(probe_ms, 3),
+            "pct_of_round": cost_pct,
+            "cost_ok": bool(cost_pct is not None
+                            and cost_pct < COMPRESS_COST_PCT),
+            "note": "S sequential host-side compresses + 1 decompress vs "
+                    "one round — upper bound (stations compress "
+                    "concurrently on a pod)",
+        },
+        "platform": jax.devices()[0].platform,
+    }))
+
+
 def worker_baseline() -> None:
     """Reference-shaped rounds: sequential stations + JSON payload hops.
 
@@ -1778,6 +1942,28 @@ def main() -> None:
     legs_done.append(leg_marker("wire_format", wf, wf_diag))
     emit()
 
+    # ---- gradient compression (wire-leg extension) --------------------
+    # CPU by design like agg_modes: the leg measures the DELTA-EXCHANGE
+    # strategies (dense vs int8+top-k+EF) and the standalone jitted
+    # compress cost, not local-training throughput.
+    cx, cx_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        cx, cx_diag = _run_worker(
+            "compression", force_cpu=not tpu_ok,
+            timeout_s=leg_timeout(COMPRESS_TIMEOUT_S),
+        )
+    if cx is None and tpu_ok and remaining() > MIN_LEG_S:
+        cx, cx_diag = _run_worker(
+            "compression", force_cpu=True,
+            timeout_s=leg_timeout(COMPRESS_TIMEOUT_S),
+        )
+    if cx is not None:
+        out["compression"] = cx
+    else:
+        out["compression_error"] = cx_diag
+    legs_done.append(leg_marker("compression", cx, cx_diag))
+    emit()
+
     # ---- MXU utilization metric (transformer) -------------------------
     tf, tf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
     if remaining() > MIN_LEG_S:
@@ -1919,6 +2105,7 @@ if __name__ == "__main__":
          "controlplane": worker_controlplane,
          "observability": worker_observability,
          "wireformat": worker_wireformat,
+         "compression": worker_compression,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()
     else:

@@ -248,6 +248,146 @@ class TestSerializationV2:
         assert after["encode_bytes"] >= before["encode_bytes"] + len(blob)
 
 
+class TestSparseWire:
+    """First-class sparse buffer type (gradient-compression PR,
+    docs/compression.md): zero-copy v2 node kind, dense v1 fallback for
+    legacy peers, tamper rejection at decode, truthful wire accounting."""
+
+    def _sv(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        return SparseVector(
+            np.array([1, 4, 9, 100], np.int32),
+            np.array([0.5, -1.5, 2.0, -3.25], np.float32),
+            128,
+        )
+
+    def test_v2_roundtrip_and_zero_copy(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        sv = self._sv()
+        blob = serialize({"delta": sv, "meta": 7}, format="v2")
+        out = deserialize(blob)
+        assert isinstance(out["delta"], SparseVector)
+        assert out["delta"] == sv
+        # zero-copy contract: decoded buffers are read-only views
+        assert not out["delta"].indices.flags.writeable
+        assert not out["delta"].values.flags.writeable
+        w = deserialize(blob, writable=True)
+        w["delta"].values[0] = 9.0  # writable decode materializes a copy
+        assert w["delta"].values[0] == 9.0
+
+    def test_int8_values_ride_one_byte_each(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        sv = SparseVector(
+            np.arange(16, dtype=np.int64) * 4,
+            np.arange(-8, 8, dtype=np.int8),
+            64,
+        )
+        out = deserialize(serialize({"q": sv}, format="v2"))
+        assert out["q"].values.dtype == np.int8
+        assert out["q"].indices.dtype == np.int64
+        assert out["q"] == sv
+
+    def test_v1_fallback_densifies_for_legacy_peers(self):
+        sv = self._sv()
+        blob = serialize({"delta": sv}, format="v1")
+        # a legacy peer's decode path: plain JSON, ndarray tag — it never
+        # needs to know SparseVector exists
+        out = deserialize(blob)
+        assert isinstance(out["delta"], np.ndarray)
+        assert np.array_equal(out["delta"], sv.to_dense())
+        assert out["delta"][0] == 0.0 and out["delta"][4] == -1.5
+
+    def test_empty_sparse_vector(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        sv = SparseVector(np.array([], np.int32), np.array([], np.float32), 8)
+        out = deserialize(serialize({"d": sv}, format="v2"))
+        assert out["d"].nnz == 0 and out["d"].size == 8
+        assert np.array_equal(out["d"].to_dense(), np.zeros(8, np.float32))
+
+    def test_constructor_validates(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        with pytest.raises(ValueError, match="out of bounds"):
+            SparseVector(np.array([8], np.int32),
+                         np.array([1.0], np.float32), 8)
+        with pytest.raises(ValueError, match="out of bounds"):
+            SparseVector(np.array([-1], np.int32),
+                         np.array([1.0], np.float32), 8)
+        with pytest.raises(ValueError, match="length mismatch"):
+            SparseVector(np.array([0, 1], np.int32),
+                         np.array([1.0], np.float32), 8)
+        with pytest.raises(ValueError, match="integer"):
+            SparseVector(np.array([0.5]), np.array([1.0], np.float32), 8)
+
+    def test_tampered_index_bounds_rejected_at_decode(self):
+        import struct
+
+        from vantage6_tpu.common.serialization import (
+            _align,
+            _read_v2_header,
+        )
+
+        sv = self._sv()
+        blob = serialize({"delta": sv}, format="v2")
+        _, pos = _read_v2_header(blob)
+        # the index buffer is the first aligned buffer in the frame; point
+        # its first entry past `size` — decode must refuse to scatter
+        bad = bytearray(blob)
+        struct.pack_into("<i", bad, _align(pos), 10**6)
+        with pytest.raises(ValueError, match="out of bounds"):
+            deserialize(bytes(bad))
+        # and a non-integer index dtype smuggled into the header dies too
+        tampered = blob.replace(b'"index_dtype":"<i4"',
+                                b'"index_dtype":"<f4"')
+        with pytest.raises(ValueError, match="integer"):
+            deserialize(tampered)
+
+    def test_wire_nbytes_counts_sparse_not_dense(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        n = 100_000
+        k = 1000
+        sv = SparseVector(
+            np.arange(k, dtype=np.int32) * 10,
+            np.zeros(k, np.int8),
+            n,
+        )
+        payload = {"delta": sv, "scales": np.zeros(n // 256, np.float32)}
+        est = wire_nbytes(payload)
+        actual = len(serialize(payload, format="v2"))
+        # truthful under compression: the estimate must track the REAL
+        # compressed frame, nowhere near the dense footprint it replaces
+        assert est is not None and abs(est - actual) < 1024
+        dense_bytes = 4 * n
+        assert actual < dense_bytes / 10
+
+    def test_golden_sparse_fixture(self):
+        # the same gate tools/check_collect.py runs in CI
+        from vantage6_tpu.common.serialization import SparseVector
+
+        out = deserialize((DATA_DIR / "golden_v2_sparse.bin").read_bytes())
+        assert out["method"] == "golden_sparse"
+        sv = out["delta"]
+        assert isinstance(sv, SparseVector)
+        assert np.array_equal(sv.indices, np.array([0, 3, 7, 42, 63]))
+        assert np.array_equal(sv.values,
+                              np.array([-3, 1, 7, 127, -90], np.int8))
+        assert sv.to_dense()[42] == 127
+
+    def test_sparse_inside_nested_structure(self):
+        from vantage6_tpu.common.serialization import SparseVector
+
+        sv = self._sv()
+        p = {"rounds": [{"delta": sv, "station": 3}], "ok": True}
+        out = deserialize(serialize(p, format="v2"))
+        assert out["rounds"][0]["delta"] == sv
+        assert out["rounds"][0]["station"] == 3
+
+
 class TestWireAccounting:
     def test_run_lifecycle_reports_payload_sizes(self):
         pd = pytest.importorskip("pandas")

@@ -9,8 +9,10 @@ every broken module, so a collection regression can never again hide
 inside a green-looking run.
 
 It ALSO decodes the committed golden wire blobs (tests/data/golden_v1.json
-and golden_v2.bin — one payload, both wire formats) and checks their
-contents against the expected values. On-disk task inputs/results and
+and golden_v2.bin — one payload, both wire formats — plus
+golden_v2_sparse.bin, the first-class sparse buffer type the gradient-
+compression stack ships) and checks their contents against the expected
+values. On-disk task inputs/results and
 cross-version peers depend on these formats decoding forever; a change to
 `common.serialization` that stops round-tripping either one is a
 wire-compat regression and fails here before any test runs.
@@ -189,9 +191,49 @@ def check_golden_blobs() -> list[str]:
     try:
         import numpy as np
 
-        from vantage6_tpu.common.serialization import deserialize
+        from vantage6_tpu.common.serialization import SparseVector, deserialize
     except Exception as e:  # pragma: no cover - environment broken
         return [f"cannot import serialization layer: {e!r}"]
+
+    # sparse golden (gradient-compression PR): the first-class v2 sparse
+    # buffer type must round-trip forever — compressed task results on
+    # disk and compressed peers depend on it exactly like the dense blobs
+    sparse_path = os.path.join(
+        _REPO_ROOT, "tests", "data", "golden_v2_sparse.bin"
+    )
+    try:
+        sparse_blob = open(sparse_path, "rb").read()
+    except OSError as e:
+        problems.append(f"golden_v2_sparse.bin: fixture unreadable ({e})")
+    else:
+        try:
+            out = deserialize(sparse_blob)
+            sv = out.get("delta")
+            dense = sv.to_dense()
+            checks = [
+                ("method", out.get("method") == "golden_sparse"),
+                ("n", out.get("n") == 64),
+                ("sparse_type", isinstance(sv, SparseVector)),
+                ("indices", np.array_equal(
+                    sv.indices, np.array([0, 3, 7, 42, 63], np.int32))),
+                ("values", sv.values.dtype == np.int8 and np.array_equal(
+                    sv.values, np.array([-3, 1, 7, 127, -90], np.int8))),
+                ("size", sv.size == 64),
+                ("dense", dense.shape == (64,) and dense[42] == 127
+                 and dense[1] == 0),
+                ("scales", isinstance(out.get("scales"), np.ndarray)
+                 and out["scales"].dtype == np.float32
+                 and np.allclose(out["scales"],
+                                 (np.arange(4) + 1.0) * 0.125)),
+            ]
+            bad = [field for field, ok in checks if not ok]
+            if bad:
+                problems.append(
+                    "golden_v2_sparse.bin: decoded but fields no longer "
+                    f"round-trip: {bad}"
+                )
+        except Exception as e:
+            problems.append(f"golden_v2_sparse.bin: failed to decode: {e!r}")
 
     expected_weights = np.arange(6, dtype=np.float32).reshape(2, 3) * 0.5
     for name in ("golden_v1.json", "golden_v2.bin"):
@@ -303,7 +345,7 @@ def main(argv: list[str]) -> int:
     if n_errors == 0 and proc.returncode == 0:
         tests = re.findall(r"^(\d+) tests? collected", out, re.M)
         counted = tests[-1] if tests else "all"
-        print("wire compat ok: golden v1+v2 blobs round-trip")
+        print("wire compat ok: golden v1+v2+sparse blobs round-trip")
         print("route audit ok: batched control-plane + observability "
               "endpoints match their call sites")
         print("telemetry audit ok: metric names unique and snake_case")

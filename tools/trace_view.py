@@ -65,6 +65,17 @@ def render_table(summary: dict) -> str:
                 per.items(), key=lambda kv: -kv[1]
             ):
                 lines.append(f"  station {station:<12} {ms:>10.3f} ms")
+    compression = summary.get("compression")
+    if compression:
+        pct = compression.get("pct_of_exec")
+        lines += [
+            "",
+            "gradient compression (device.compress/decompress):",
+            f"  compress   {compression['compress_total_ms']:>10.3f} ms",
+            f"  decompress {compression['decompress_total_ms']:>10.3f} ms",
+        ]
+        if pct is not None:
+            lines.append(f"  cost vs exec total: {pct}%")
     return "\n".join(lines)
 
 

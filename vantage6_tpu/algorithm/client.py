@@ -99,6 +99,21 @@ class AlgorithmClient:
             task_id, weights=weights, agg_mode=agg_mode
         )
 
+    def compress_update(self, tree: Any, name: str = "update") -> Any:
+        """Compress a model-delta pytree for the uplink under the
+        federation's configured compressor, with THIS station's
+        error-feedback accumulator (docs/compression.md). A partial
+        returns the compressed payload as its result; the central side
+        folds it back with ``decompress_update``. Pass-through when no
+        compressor is configured, so the call can stay in place
+        unconditionally."""
+        return self._fed.compress_update(self._station, tree, name=name)
+
+    def decompress_update(self, payload: Any) -> Any:
+        """Materialize the dense update from a `compress_update` payload
+        (pass-through for uncompressed results)."""
+        return self._fed.decompress_update(payload)
+
 
 class _TaskSubClient:
     def __init__(self, parent: AlgorithmClient):

@@ -27,10 +27,46 @@ class RestAlgorithmClient:
         # probed; see common.rest.await_task_finished) — an old proxy
         # without the /api/event forward demotes this client to polling
         self._event_push: bool | None = None
+        # gradient compression for containerized algorithm code: armed by
+        # the node operator via V6T_COMPRESS (docs/compression.md); lazy —
+        # the fed/jax import only happens when compression is armed
+        self._compressor: Any = None
         self.task = _TaskSub(self)
         self.result = _ResultSub(self)
         self.run = _RunSub(self)
         self.organization = _OrgSub(self)
+
+    def _delta_compressor(self):
+        if self._compressor is None:
+            from vantage6_tpu.fed.compression import (
+                DeltaCompressor,
+                spec_from_env,
+            )
+
+            spec = spec_from_env()
+            self._compressor = (
+                DeltaCompressor(spec) if spec is not None else False
+            )
+        return self._compressor or None
+
+    # ------------------------------------------------- gradient compression
+    # Surface parity with the in-process AlgorithmClient: same two calls,
+    # pass-throughs unless the node armed V6T_COMPRESS. NOTE: under
+    # mode="sandbox" each run is a fresh subprocess, so error-feedback
+    # accumulators only persist for inline/persistent algorithm processes.
+    def compress_update(self, tree: Any, name: str = "update") -> Any:
+        comp = self._delta_compressor()
+        return comp.compress(tree, name) if comp is not None else tree
+
+    def decompress_update(self, payload: Any) -> Any:
+        # pass-throughs must not pull in fed/jax: test the wire tag
+        # inline (compression.WIRE_TAG — pinned by
+        # tests/test_compression.py::test_rest_client_tag_literal_in_sync)
+        if not (isinstance(payload, dict) and "v6t.compressed" in payload):
+            return payload
+        from vantage6_tpu.fed.compression import decompress_wire_tree
+
+        return decompress_wire_tree(payload)
 
     # ------------------------------------------------------------------ http
     def request(

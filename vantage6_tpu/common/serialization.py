@@ -138,10 +138,114 @@ class WireStats:
 WIRE_STATS = WireStats()
 
 
+# ----------------------------------------------------------- sparse buffers
+class SparseVector:
+    """Run-length/index sparse buffer — the v2 wire's first-class sparse
+    type (gradient-compression PR, docs/compression.md).
+
+    A flat COO vector: ``indices`` (ascending integer positions into a
+    dense ``size``-element vector) and parallel ``values`` (any numeric
+    dtype — f32 top-k survivors or int8 quantization codes). Positions not
+    listed hold ``fill`` (0 by default — exactly what a dropped top-k
+    coordinate means).
+
+    On the v2 wire, indices and values ride as TWO aligned raw buffers
+    (zero-copy decode, like ndarrays); on the v1 wire a SparseVector
+    densifies to a plain ndarray tag so legacy peers decode it without
+    knowing the type exists (``to_dense()`` semantics — the existing
+    wire_format capability detection picks which encoding a peer gets).
+    Decode validates index bounds: a tampered frame whose indices point
+    outside ``[0, size)`` is rejected, never scattered out of bounds.
+    """
+
+    __slots__ = ("indices", "values", "size", "fill")
+
+    def __init__(
+        self,
+        indices: Any,
+        values: Any,
+        size: int,
+        fill: float = 0.0,
+    ) -> None:
+        indices = np.asarray(indices)
+        values = np.asarray(values)
+        if indices.ndim != 1 or values.ndim != 1:
+            raise ValueError("SparseVector indices/values must be 1-D")
+        if indices.dtype.kind not in "iu":
+            raise ValueError(
+                f"SparseVector indices must be integers, got {indices.dtype}"
+            )
+        _check_binary_dtype(values.dtype)
+        if len(indices) != len(values):
+            raise ValueError(
+                f"SparseVector length mismatch: {len(indices)} indices vs "
+                f"{len(values)} values"
+            )
+        size = int(size)
+        if size < 0:
+            raise ValueError("SparseVector size must be >= 0")
+        if indices.size and (
+            int(indices.min()) < 0 or int(indices.max()) >= size
+        ):
+            raise ValueError(
+                "SparseVector index out of bounds for size "
+                f"{size}: [{int(indices.min())}, {int(indices.max())}]"
+            )
+        self.indices = indices
+        self.values = values
+        self.size = size
+        self.fill = fill
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.size)
+
+    @property
+    def density(self) -> float:
+        return self.nnz / self.size if self.size else 0.0
+
+    def wire_nbytes(self) -> int:
+        """Exact v2 buffer bytes (indices + values, no alignment/header)."""
+        return int(self.indices.nbytes) + int(self.values.nbytes)
+
+    def to_dense(self) -> np.ndarray:
+        """Materialize the dense vector (the v1-peer fallback encoding)."""
+        out = np.full(self.size, self.fill, dtype=self.values.dtype)
+        out[self.indices] = self.values
+        return out
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, SparseVector)
+            and self.size == other.size
+            and self.fill == other.fill
+            and self.values.dtype == other.values.dtype
+            and np.array_equal(self.indices, other.indices)
+            and np.array_equal(self.values, other.values)
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"SparseVector(nnz={self.nnz}, size={self.size}, "
+            f"dtype={self.values.dtype})"
+        )
+
+
 # ------------------------------------------------------------- v1 (json)
 def _encode_v1(obj: Any) -> Any:
     import jax
 
+    if isinstance(obj, SparseVector):
+        # dense materialization for legacy peers: a v1 consumer decodes a
+        # plain ndarray with fill at the dropped positions — semantically
+        # the decompressed vector (see compress_flat's layout contract)
+        arr = obj.to_dense()
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        return {
+            "__v6t__": "ndarray",
+            "data": base64.b64encode(buf.getvalue()).decode("ascii"),
+        }
     if isinstance(obj, np.generic):
         # preserve the scalar TYPE (np.float32(1.5) must not come back as a
         # 0-d ndarray — satellite fix); np.float64/np.int_ subclasses of
@@ -220,6 +324,22 @@ def _encode_v2(obj: Any, buffers: list[Any]) -> Any:
         # rides as a plain float; narrower np scalars fall through to the
         # npscalar tag below and keep their dtype
         return obj
+    if isinstance(obj, SparseVector):
+        # first-class sparse node: indices and values as two aligned raw
+        # buffers — zero-copy decode, no densification on the wire
+        idx = np.ascontiguousarray(obj.indices)
+        vals = np.ascontiguousarray(obj.values)
+        buffers.append(memoryview(idx).cast("B") if idx.size else b"")
+        buffers.append(memoryview(vals).cast("B") if vals.size else b"")
+        return {
+            "__v6t__": "sparse",
+            "index_buffer": len(buffers) - 2,
+            "value_buffer": len(buffers) - 1,
+            "index_dtype": idx.dtype.str,
+            "value_dtype": vals.dtype.str,
+            "size": int(obj.size),
+            "fill": float(obj.fill),
+        }
     if isinstance(obj, np.generic):
         return {
             "__v6t__": "npscalar",
@@ -335,6 +455,28 @@ def _decode_v2(node: Any, views: list[memoryview], writable: bool) -> Any:
         # zero-copy view into the frame, read-only by construction;
         # writable=True materializes one copy (v1 np.load semantics)
         return arr.copy() if writable else arr
+    if tag == "sparse":
+        idx_dtype = np.dtype(node["index_dtype"])
+        if idx_dtype.kind not in "iu":
+            raise ValueError(
+                f"malformed v2 frame: sparse index dtype {idx_dtype} "
+                "is not an integer type"
+            )
+        val_dtype = np.dtype(node["value_dtype"])
+        _check_binary_dtype(val_dtype)
+        idx = np.frombuffer(views[node["index_buffer"]], dtype=idx_dtype)
+        vals = np.frombuffer(views[node["value_buffer"]], dtype=val_dtype)
+        if writable:
+            idx, vals = idx.copy(), vals.copy()
+        try:
+            # the ctor enforces the bounds contract: tampered indices
+            # pointing outside [0, size) must die HERE, at decode — never
+            # reach a consumer's scatter
+            return SparseVector(
+                idx, vals, int(node["size"]), fill=node.get("fill", 0.0)
+            )
+        except ValueError as e:
+            raise ValueError(f"malformed v2 frame: {e}") from e
     if tag == "npscalar":
         raw = base64.b64decode(node["data"])
         return np.frombuffer(raw, dtype=np.dtype(node["dtype"]))[0]
@@ -451,6 +593,13 @@ def wire_nbytes(payload: Any) -> int | None:
     wire cannot carry (host-mode in-process results may be arbitrary
     objects). Used by the run-lifecycle wire accounting so straggler
     analysis can tell compute-bound from transfer-bound stations.
+
+    Sparse/quantized buffers are sized by what actually rides the wire:
+    a `SparseVector` counts its index + value buffers (NOT the dense
+    ``size * itemsize`` it stands for), and int8 quantization codes count
+    one byte per element via their real ``nbytes`` — so
+    ``Run.input/result_wire_bytes`` and ``metrics.wire_totals`` stay
+    truthful under compression.
     """
     try:
         total = 0
@@ -459,6 +608,13 @@ def wire_nbytes(payload: Any) -> int | None:
             nonlocal total
             if obj is None or isinstance(obj, (bool, int, float, str)):
                 return obj
+            if isinstance(obj, SparseVector):
+                # two aligned buffers + the sparse header node — never the
+                # dense footprint this vector REPLACES on the wire
+                total += _align(int(obj.indices.nbytes))
+                total += _align(int(obj.values.nbytes))
+                total += 128  # header node (tag, dtypes, size, buffer ids)
+                return 0
             if isinstance(obj, np.generic):
                 total += int(obj.dtype.itemsize) + 32
                 return 0

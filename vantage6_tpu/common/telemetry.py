@@ -88,6 +88,19 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
     ("v6t_executor_pools", "gauge", "live StationExecutor pools in this process"),
     ("v6t_executor_inflight_items", "gauge",
      "run items queued or executing across live pools"),
+    # gradient compression (fed.compression — docs/compression.md)
+    ("v6t_compress_calls_total", "counter",
+     "delta compress operations (one per station uplink)"),
+    ("v6t_compress_raw_bytes_total", "counter",
+     "dense f32 bytes entering the compressor"),
+    ("v6t_compress_wire_bytes_total", "counter",
+     "bytes actually shipped after quantization/sparsification"),
+    ("v6t_decompress_calls_total", "counter",
+     "delta decompress operations (server-side reconstructions)"),
+    ("v6t_compress_ratio", "gauge",
+     "raw/wire on-wire reduction of the latest compress"),
+    ("v6t_compress_ef_norm", "gauge",
+     "L2 norm of the most recent error-feedback accumulator"),
     # tracing health (runtime.tracing)
     ("v6t_trace_spans_recorded_total", "counter", "spans recorded to the ring buffer"),
     ("v6t_trace_spans_dropped_total", "counter",

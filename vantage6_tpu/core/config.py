@@ -102,6 +102,13 @@ class FederationConfig:
     #   N>0  -> exactly N worker threads (per-station serialization holds
     #           at any size).
     executor_workers: int | None = None
+    # Gradient compression of host-plane delta exchanges (a
+    # fed.compression.CompressorSpec, or None): when set, algorithm code
+    # can route update payloads through client.compress_update /
+    # client.decompress_update and the federation keeps per-station
+    # error-feedback accumulators between rounds (docs/compression.md).
+    # Typed Any so core stays import-light; validate() duck-checks it.
+    compressor: Any = None
     stations: list[StationConfig] = dataclasses.field(default_factory=list)
     server: dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -122,6 +129,17 @@ class FederationConfig:
             raise ConfigurationError(
                 "executor_workers must be >= 0 (0 = synchronous dispatch)"
             )
+        if self.compressor is not None:
+            validate = getattr(self.compressor, "validate", None)
+            if not callable(validate):
+                raise ConfigurationError(
+                    "compressor must be a CompressorSpec "
+                    "(vantage6_tpu.fed.compression) or None"
+                )
+            try:
+                validate()
+            except ValueError as e:
+                raise ConfigurationError(f"bad compressor: {e}") from e
         names = [s.name for s in self.stations]
         if len(names) != len(set(names)):
             raise ConfigurationError("duplicate station names")
@@ -153,11 +171,42 @@ class FederationConfig:
                 )
             )
         workers = fed.get("executor_workers")
+        compressor = None
+        comp_raw = fed.get("compression")
+        if comp_raw:
+            if not isinstance(comp_raw, dict):
+                raise ConfigurationError(
+                    "federation.compression must be a mapping "
+                    "(topk_ratio/int8/chunk/error_feedback), got "
+                    f"{comp_raw!r}"
+                )
+            # unknown keys fail LOUD: a typo ('topk:' — the V6T_COMPRESS
+            # spelling — instead of 'topk_ratio:') would otherwise build
+            # an identity spec and silently disable compression
+            allowed = {"topk_ratio", "int8", "chunk", "error_feedback"}
+            unknown = set(comp_raw) - allowed
+            if unknown:
+                raise ConfigurationError(
+                    "federation.compression: unknown key(s) "
+                    f"{sorted(unknown)} (expected {sorted(allowed)})"
+                )
+            # lazy import: core stays free of the fed/jax dependency unless
+            # a config actually turns compression on
+            from vantage6_tpu.fed.compression import CompressorSpec
+
+            ratio = comp_raw.get("topk_ratio")
+            compressor = CompressorSpec(
+                topk_ratio=None if ratio is None else float(ratio),
+                int8=bool(comp_raw.get("int8", False)),
+                chunk=int(comp_raw.get("chunk", 256)),
+                error_feedback=bool(comp_raw.get("error_feedback", True)),
+            )
         cfg = cls(
             name=fed.get("name", "federation"),
             encrypted=bool(fed.get("encrypted", False)),
             devices_per_station=int(fed.get("devices_per_station", 1)),
             executor_workers=None if workers is None else int(workers),
+            compressor=compressor,
             stations=stations,
             server=raw.get("server", {}) or {},
         )

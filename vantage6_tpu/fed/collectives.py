@@ -180,6 +180,40 @@ def unflatten_like(template: Pytree, flat: jax.Array) -> Pytree:
     return jax.tree.unflatten(treedef, out)
 
 
+def flatten_stacked(stacked: Pytree) -> jax.Array:
+    """Per-station flat-pack: [S, ...] pytree -> ONE [S, N] f32 matrix
+    (row i = station i's delta, leaves concatenated in tree order).
+
+    The seam the gradient-compression stack operates at
+    (docs/compression.md): compressors consume flat per-station vectors,
+    never pytrees — same flat layout as ``flatten_tree`` per row.
+    """
+    leaves = jax.tree.leaves(stacked)
+    if not leaves:
+        raise ValueError("empty pytree")
+    s = leaves[0].shape[0]
+    parts = [x.astype(jnp.float32).reshape(s, -1) for x in leaves]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
+def unflatten_stacked(template: Pytree, flat: jax.Array) -> Pytree:
+    """Inverse of ``flatten_stacked``: [S, N] rows back into a stacked
+    pytree shaped/dtyped like ``template`` (a PER-STATION pytree, i.e.
+    one station's leaf shapes) with the leading station axis restored."""
+    leaves, treedef = jax.tree.flatten(template)
+    s = flat.shape[0]
+    out, off = [], 0
+    for leaf in leaves:
+        size = math.prod(leaf.shape)
+        out.append(
+            flat[:, off:off + size]
+            .reshape((s,) + tuple(leaf.shape))
+            .astype(leaf.dtype)
+        )
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
 def _local_weighted_flat_sum(
     local_stacked: Pytree, local_w: jax.Array
 ) -> jax.Array:

@@ -1,0 +1,768 @@
+"""Gradient compression at the delta-exchange boundary (docs/compression.md).
+
+PR 1 narrowed the scattered exchange to bf16 (2x) and PR 3 removed the
+framing overhead (25%), but every FedAvg round still ships a DENSE
+full-model delta per station. The communication-perspective survey
+(PAPERS.md, arXiv 2405.20431) is explicit that the next order of magnitude
+comes from quantization + sparsification. This module is that layer — one
+composable :class:`CompressorSpec` applied to flat per-station deltas at
+the seam the flat-pack helpers in ``fed.collectives`` already define:
+
+- **stochastic int8 quantization**: per-chunk scale (``chunk`` elements
+  share one f32 scale, so outliers only poison their own chunk) with
+  UNBIASED stochastic rounding — ``E[dequantize(quantize(x))] == x``
+  exactly, so quantization noise averages out across stations and rounds
+  instead of accumulating as bias (pinned by
+  tests/test_compression.py::test_int8_roundtrip_is_unbiased).
+- **top-k sparsification**: keep the k = ``topk_ratio * n`` largest-
+  magnitude entries; the survivors' positions ride as an index buffer
+  (the v2 wire's first-class sparse type, `serialization.SparseVector`).
+- **error feedback** (Stich et al. / Karimireddy et al.): each station
+  keeps an accumulator of everything compression threw away and re-injects
+  it into the NEXT round's delta before compressing — the invariant that
+  makes aggressive top-k converge. The accumulator update is exact by
+  construction: ``new_ef = acc - decompress(compress(acc))``.
+
+Composition order (one wire hop, applied left to right)::
+
+    delta --+ef--> [cast comm_dtype] --> top-k --> int8 --> wire
+                 \\________________ error feedback ________________/
+
+i.e. the ``comm_dtype`` cast happens FIRST (matching the scattered
+exchange's existing bf16 narrowing — cast, then quantize) and the error
+feedback captures the TOTAL wire error including the cast.
+
+Everything under ``compress_flat``/``decompress_flat`` is pure jax and
+jit/vmap/scan-safe (no host syncs, no impure calls — the v6lint tracer
+pass checks the traced closure). The host-level entries
+(:func:`compress_delta` / :func:`decompress_delta`) wrap the jitted ops in
+``device.compress`` / ``device.decompress`` trace spans and feed the
+``v6t_compress_*`` telemetry series.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vantage6_tpu.common.serialization import SparseVector
+from vantage6_tpu.common.telemetry import REGISTRY
+
+Pytree = Any
+
+# wire payload marker: decompress_delta recognizes payloads by this key so
+# a pass-through (no compressor) tree is returned unchanged
+WIRE_TAG = "v6t.compressed"
+_WIRE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressorSpec:
+    """One composable compressor configuration (hashable — jit-static).
+
+    ``topk_ratio``: fraction of delta entries kept (None = dense).
+    ``int8``: stochastic int8 quantization of the (kept) values.
+    ``chunk``: elements sharing one quantization scale.
+    ``error_feedback``: per-station accumulators re-injecting compression
+    error into the next round's delta (keep on unless ablating).
+    """
+
+    topk_ratio: float | None = None
+    int8: bool = False
+    chunk: int = 256
+    error_feedback: bool = True
+
+    def validate(self) -> None:
+        if self.topk_ratio is not None and not 0.0 < self.topk_ratio <= 1.0:
+            raise ValueError(
+                f"topk_ratio must be in (0, 1], got {self.topk_ratio}"
+            )
+        if self.chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {self.chunk}")
+
+    @property
+    def identity(self) -> bool:
+        """True when this spec compresses nothing (dense f32 pass-through)."""
+        return self.topk_ratio is None and not self.int8
+
+    def k_for(self, n: int) -> int:
+        """Static survivor count for an n-element delta."""
+        if self.topk_ratio is None:
+            return n
+        return max(1, min(n, int(round(self.topk_ratio * n))))
+
+    # ------------------------------------------------------ wire accounting
+    def wire_nbytes(self, n: int) -> int:
+        """On-wire bytes of ONE station's compressed n-element delta —
+        metadata-only (never touches data), the number `serialization.
+        wire_nbytes` and the bench's reduction ratio are built from."""
+        if self.identity:
+            return 4 * n
+        k = self.k_for(n)
+        total = 0
+        if self.topk_ratio is not None:
+            total += 4 * k  # int32 index buffer
+        if self.int8:
+            total += k  # int8 values (codes)
+            # scales are DENSE-layout (see compress_flat): one f32 per
+            # dense chunk regardless of sparsification
+            total += 4 * math.ceil(n / self.chunk)
+        else:
+            total += 4 * k  # f32 values
+        return total
+
+    def ratio(self, n: int) -> float:
+        """Dense-f32 bytes / compressed bytes for an n-element delta."""
+        return 4.0 * n / max(1, self.wire_nbytes(n))
+
+
+# ---------------------------------------------------------------- jitted ops
+# All functions below are traced (jit/vmap): pure jax, no host syncs.
+
+
+def _chunk_pad(n: int, chunk: int) -> tuple[int, int]:
+    """(n_chunks, pad) for an n-element vector at this chunk size."""
+    c = -(-n // chunk)
+    return c, c * chunk - n
+
+
+def quantize_int8(
+    x: jax.Array, key: jax.Array, chunk: int
+) -> tuple[jax.Array, jax.Array]:
+    """Stochastic int8 quantization with per-chunk scale.
+
+    Returns ``(q int8 [n], scales f32 [ceil(n/chunk)])`` with
+    ``scale_c = max(|x_c|) / 127`` per chunk and UNBIASED rounding:
+    ``q = floor(x/scale + u)``, u ~ U[0,1) — E[q * scale] == x exactly
+    (an all-zero chunk quantizes to zeros at scale 0).
+    """
+    n = x.shape[0]
+    c, pad = _chunk_pad(n, chunk)
+    xp = jnp.pad(x, (0, pad)).reshape(c, chunk)
+    scales = jnp.max(jnp.abs(xp), axis=1) / 127.0
+    scaled = jnp.where(scales[:, None] > 0, xp / scales[:, None], 0.0)
+    u = jax.random.uniform(key, xp.shape)
+    q = jnp.clip(jnp.floor(scaled + u), -127, 127).astype(jnp.int8)
+    return q.reshape(-1)[:n], scales
+
+
+def dequantize_int8(
+    q: jax.Array, scales: jax.Array, chunk: int
+) -> jax.Array:
+    """Inverse of :func:`quantize_int8` (exact given the same scales)."""
+    n = q.shape[0]
+    c, pad = _chunk_pad(n, chunk)
+    qp = jnp.pad(q, (0, pad)).reshape(c, chunk).astype(jnp.float32)
+    return (qp * scales[:, None]).reshape(-1)[:n]
+
+
+def topk_sparsify(x: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Indices (int32, ascending) and values of the k largest-|x| entries."""
+    _, idx = jax.lax.top_k(jnp.abs(x), k)
+    idx = jnp.sort(idx).astype(jnp.int32)
+    return idx, jnp.take(x, idx)
+
+
+def compress_flat(
+    spec: CompressorSpec, flat: jax.Array, key: jax.Array
+) -> dict[str, jax.Array]:
+    """flat [n] f32 -> payload dict of arrays (static structure per spec):
+    ``indices`` (top-k), then ``q``+``scales`` (int8) or ``values``.
+
+    LAYOUT CONTRACT: with ``int8``, quantization chunks (and therefore the
+    ``scales`` vector) are laid out over the DENSE n-element vector, and
+    top-k then selects dense-position codes (``scales[idx // chunk]``
+    dequantizes a survivor). This is what makes the legacy-v1 dense
+    fallback exact: scattering the int8 codes back to their dense
+    positions (code 0 dequantizes to 0.0) and dequantizing with the SAME
+    dense-layout scales reproduces the decompressed delta bit-for-bit —
+    a compacted-layout scale vector could not survive densification.
+    """
+    payload: dict[str, jax.Array] = {}
+    x = flat.astype(jnp.float32)
+    if spec.int8:
+        q, scales = quantize_int8(x, key, spec.chunk)
+        payload["scales"] = scales
+        if spec.topk_ratio is not None:
+            idx, _ = topk_sparsify(x, spec.k_for(flat.shape[0]))
+            payload["indices"] = idx
+            payload["q"] = jnp.take(q, idx)
+        else:
+            payload["q"] = q
+    elif spec.topk_ratio is not None:
+        idx, vals = topk_sparsify(x, spec.k_for(flat.shape[0]))
+        payload["indices"] = idx
+        payload["values"] = vals
+    else:
+        payload["values"] = x
+    return payload
+
+
+def decompress_flat(
+    spec: CompressorSpec, payload: dict[str, jax.Array], n: int
+) -> jax.Array:
+    """Payload -> dense f32 [n]. Bit-identical to the ``hat`` the
+    compressor fed its error-feedback update (same dequantize path)."""
+    if spec.topk_ratio is not None:
+        idx = payload["indices"]
+        if spec.int8:
+            # dense-layout scales: a survivor at dense position i
+            # dequantizes with its dense chunk's scale
+            scale = jnp.take(payload["scales"], idx // spec.chunk)
+            vals = payload["q"].astype(jnp.float32) * scale
+        else:
+            vals = payload["values"].astype(jnp.float32)
+        return jnp.zeros((n,), jnp.float32).at[idx].set(vals)
+    if spec.int8:
+        return dequantize_int8(payload["q"], payload["scales"], spec.chunk)
+    return payload["values"].astype(jnp.float32)
+
+
+def compress_with_feedback(
+    spec: CompressorSpec,
+    flat: jax.Array,
+    ef: jax.Array | None,
+    key: jax.Array,
+    cast_dtype: Any = None,
+) -> tuple[dict[str, jax.Array], jax.Array, jax.Array]:
+    """One station's full compress step: EF re-injection -> optional
+    ``cast_dtype`` narrowing (cast, then quantize — the comm_dtype
+    composition order) -> compress -> exact error-feedback update.
+
+    Returns ``(payload, hat, new_ef)`` where ``hat`` is the dense
+    decompressed delta (what the server will reconstruct) and
+    ``new_ef = acc - hat`` EXACTLY — the dropped/rounded mass, re-injected
+    next round. With ``error_feedback=False`` new_ef stays zero.
+    """
+    x = flat.astype(jnp.float32)
+    acc = x + ef if (spec.error_feedback and ef is not None) else x
+    wire_val = (
+        acc.astype(cast_dtype).astype(jnp.float32)
+        if cast_dtype is not None
+        else acc
+    )
+    payload = compress_flat(spec, wire_val, key)
+    hat = decompress_flat(spec, payload, flat.shape[0])
+    new_ef = (
+        acc - hat if spec.error_feedback else jnp.zeros_like(acc)
+    )
+    return payload, hat, new_ef
+
+
+def compress_stacked(
+    spec: CompressorSpec,
+    flat: jax.Array,      # [S, n] per-station flat deltas
+    ef: jax.Array,        # [S, n] per-station error-feedback accumulators
+    keys: jax.Array,      # [S] per-station RNG keys
+    cast_dtype: Any = None,
+) -> tuple[dict[str, jax.Array], jax.Array, jax.Array]:
+    """Per-station compress over the leading station axis (vmap) — each
+    station draws its own stochastic-rounding noise and keeps its own
+    accumulator. Returns stacked (payload, hat [S, n], new_ef [S, n])."""
+
+    def one(x: jax.Array, e: jax.Array, k: jax.Array):
+        return compress_with_feedback(spec, x, e, k, cast_dtype=cast_dtype)
+
+    return jax.vmap(one)(flat, ef, keys)
+
+
+def ef_norm(ef: jax.Array) -> jax.Array:
+    """L2 norm of an error-feedback accumulator (per round, on device —
+    callers pull it explicitly; nothing in the round program syncs)."""
+    return jnp.sqrt(jnp.sum(jnp.square(ef.astype(jnp.float32))))
+
+
+# ----------------------------------------------------------- host-level API
+# jit caches keyed by (spec, shape) via jit's own cache — spec is a frozen
+# (hashable) dataclass, so marking it static is enough.
+_compress_jit = jax.jit(
+    compress_with_feedback, static_argnums=(0,), static_argnames=("cast_dtype",)
+)
+_decompress_jit = jax.jit(decompress_flat, static_argnums=(0, 2))
+
+
+def _record_compress_telemetry(spec: CompressorSpec, n: int, count: int = 1):
+    raw = 4 * n * count
+    wire = spec.wire_nbytes(n) * count
+    REGISTRY.counter("v6t_compress_calls_total").inc(count)
+    REGISTRY.counter("v6t_compress_raw_bytes_total").inc(raw)
+    REGISTRY.counter("v6t_compress_wire_bytes_total").inc(wire)
+    REGISTRY.gauge("v6t_compress_ratio").set(raw / max(1, wire))
+
+
+def record_round_telemetry(
+    spec: CompressorSpec, n: int, n_stations: int, rounds: int = 1
+) -> None:
+    """Account an engine round's delta exchange (every station uplinks one
+    compressed n-element delta per round) in the ``v6t_compress_*`` series.
+    Host-side and metadata-only — called by the FedAvg engine per round()/
+    run_rounds(), never from traced code."""
+    _record_compress_telemetry(spec, n, count=n_stations * rounds)
+
+
+def compress_delta(
+    spec: CompressorSpec,
+    flat: Any,
+    ef: Any = None,
+    key: jax.Array | None = None,
+    cast_dtype: Any = None,
+    station: int | None = None,
+) -> tuple[dict[str, Any], jax.Array, jax.Array]:
+    """Host-level compress of one flat delta: the jitted ops recorded as a
+    ``device.compress`` trace span (no-op outside a trace) + telemetry.
+
+    Returns ``(payload, hat, new_ef)`` like :func:`compress_with_feedback`;
+    ``key=None`` derives a fixed key (deterministic — fine for tests, wrong
+    for production unbiasedness; pass a fresh key per round).
+    """
+    from vantage6_tpu.runtime.tracing import TRACER
+
+    flat = jnp.asarray(flat, jnp.float32)
+    n = flat.shape[0]
+    if key is None:
+        key = jax.random.key(0)
+    if ef is None:
+        ef = jnp.zeros_like(flat)
+    attrs = {
+        "n": int(n),
+        "raw_bytes": 4 * int(n),
+        "wire_bytes": spec.wire_nbytes(int(n)),
+    }
+    if station is not None:
+        attrs["station"] = int(station)
+    with TRACER.span(
+        "device.compress", kind="device", attrs=attrs, require_parent=True,
+    ):
+        payload, hat, new_ef = _compress_jit(
+            spec, flat, ef, key, cast_dtype=cast_dtype
+        )
+        jax.block_until_ready(hat)  # span must cover the device work
+    _record_compress_telemetry(spec, int(n))
+    REGISTRY.gauge("v6t_compress_ef_norm").set(float(ef_norm(new_ef)))
+    return payload, hat, new_ef
+
+
+def decompress_delta(spec: CompressorSpec, payload: dict[str, Any], n: int):
+    """Host-level decompress (server side), recorded as a
+    ``device.decompress`` span + counted in telemetry."""
+    from vantage6_tpu.runtime.tracing import TRACER
+
+    with TRACER.span(
+        "device.decompress", kind="device",
+        attrs={"n": int(n), "wire_bytes": spec.wire_nbytes(int(n))},
+        require_parent=True,
+    ):
+        dense = _decompress_jit(
+            spec, {k: jnp.asarray(v) for k, v in payload.items()}, n
+        )
+        jax.block_until_ready(dense)
+    REGISTRY.counter("v6t_decompress_calls_total").inc()
+    return dense
+
+
+# -------------------------------------------------------------- wire format
+def payload_to_wire(
+    spec: CompressorSpec, payload: dict[str, Any], n: int
+) -> dict[str, Any]:
+    """Device payload -> wire-serializable dict: the top-k half becomes a
+    first-class `SparseVector` (indices + int8/f32 values over the dense
+    length), scales/metadata ride beside it. Legacy v1 peers densify the
+    SparseVector automatically (serialization's dense fallback)."""
+    out: dict[str, Any] = {
+        WIRE_TAG: _WIRE_VERSION,
+        "n": int(n),
+        "spec": {
+            "topk_ratio": spec.topk_ratio,
+            "int8": spec.int8,
+            "chunk": spec.chunk,
+        },
+    }
+    if spec.topk_ratio is not None:
+        vals = payload["q"] if spec.int8 else payload["values"]
+        out["sparse"] = SparseVector(
+            np.asarray(payload["indices"]), np.asarray(vals), int(n)
+        )
+    elif spec.int8:
+        out["q"] = np.asarray(payload["q"])
+    else:
+        out["values"] = np.asarray(payload["values"])
+    if spec.int8:
+        out["scales"] = np.asarray(payload["scales"])
+    return out
+
+
+def spec_from_wire(wire: dict[str, Any]) -> CompressorSpec:
+    """Reconstruct the (quantization-relevant) spec a wire payload was
+    compressed under — the server must dequantize with the SENDER's
+    parameters, not its own config."""
+    s = wire.get("spec", {})
+    spec = CompressorSpec(
+        topk_ratio=s.get("topk_ratio"),
+        int8=bool(s.get("int8", False)),
+        chunk=int(s.get("chunk", 256)),
+    )
+    spec.validate()
+    return spec
+
+
+def is_wire_payload(obj: Any) -> bool:
+    return isinstance(obj, dict) and WIRE_TAG in obj
+
+
+# Decompression allocates a dense [n] f32 vector from a payload that can
+# be much smaller than n (that is the point of sparse) — an UNTRUSTED
+# peer must not turn a 100-byte frame into a terabyte allocation. The cap
+# is generous (2**28 elements = 1 GiB f32, ~256M params) and overridable
+# for genuinely larger models.
+_MAX_ELEMENTS_ENV = "V6T_COMPRESS_MAX_ELEMENTS"
+_DEFAULT_MAX_ELEMENTS = 2**28
+
+
+def _max_elements() -> int:
+    raw = os.environ.get(_MAX_ELEMENTS_ENV, "")
+    try:
+        return int(raw) if raw.strip() else _DEFAULT_MAX_ELEMENTS
+    except ValueError:
+        return _DEFAULT_MAX_ELEMENTS
+
+
+def wire_to_payload(
+    wire: dict[str, Any],
+) -> tuple[CompressorSpec, dict[str, Any], int]:
+    """Wire dict -> (spec, device payload, n) for :func:`decompress_delta`.
+
+    Tolerates the v1 dense fallback: a legacy peer that re-encoded the
+    frame dense (SparseVector -> ndarray) still decompresses — the dense
+    array is scattered back through its nonzero structure losslessly only
+    when indices survive, so the fallback path reconstructs from dense
+    directly instead.
+
+    VALIDATES the peer-supplied metadata before anything allocates
+    (same stance as the sparse decode's bounds check): ``n`` is capped
+    (``V6T_COMPRESS_MAX_ELEMENTS``), a sparse half must span exactly
+    ``n`` (a disagreeing size would let out-of-range indices be silently
+    dropped by the scatter instead of rejected), dense halves must carry
+    exactly ``n`` values, int8 payloads exactly ``ceil(n/chunk)`` scales,
+    and missing fields raise ValueError, never KeyError.
+    """
+    if not is_wire_payload(wire):
+        raise ValueError("not a v6t compressed delta payload")
+    spec = spec_from_wire(wire)
+    try:
+        n = int(wire["n"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed compressed payload: bad n ({e!r})") from e
+    if n < 0 or n > _max_elements():
+        raise ValueError(
+            f"malformed compressed payload: n={n} outside [0, "
+            f"{_max_elements()}] (raise {_MAX_ELEMENTS_ENV} for larger "
+            "models)"
+        )
+
+    def field(key: str) -> Any:
+        if key not in wire:
+            raise ValueError(
+                f"malformed compressed payload: missing {key!r}"
+            )
+        return wire[key]
+
+    payload: dict[str, Any] = {}
+    if spec.topk_ratio is not None:
+        sp = field("sparse")
+        if isinstance(sp, SparseVector):
+            if sp.size != n:
+                raise ValueError(
+                    "malformed compressed payload: sparse size "
+                    f"{sp.size} != n {n}"
+                )
+            payload["indices"] = sp.indices
+            payload["q" if spec.int8 else "values"] = sp.values
+        else:
+            # densified by a legacy v1 hop (SparseVector -> plain ndarray):
+            # values are already scattered to their dense positions, and
+            # the scales are dense-layout by the compress_flat contract, so
+            # the payload decompresses as a non-sparse one bit-for-bit
+            # (dropped positions carry code/value 0 -> 0.0)
+            spec = dataclasses.replace(spec, topk_ratio=None)
+            if spec.int8:
+                payload["q"] = np.asarray(sp, np.int8)
+            else:
+                payload["values"] = np.asarray(sp, np.float32)
+    elif spec.int8:
+        payload["q"] = np.asarray(field("q"))
+    else:
+        payload["values"] = np.asarray(field("values"))
+    for key, want in (("q", n), ("values", n)):
+        if key in payload and spec.topk_ratio is None and len(
+            payload[key]
+        ) != want:
+            raise ValueError(
+                f"malformed compressed payload: {key} carries "
+                f"{len(payload[key])} values, expected {want}"
+            )
+    if spec.int8:
+        payload["scales"] = np.asarray(field("scales"))
+        want = -(-n // spec.chunk)
+        if len(payload["scales"]) != want:
+            raise ValueError(
+                "malformed compressed payload: "
+                f"{len(payload['scales'])} scales, expected {want}"
+            )
+    return spec, payload, n
+
+
+def decompress_wire_tree(payload: Any) -> Any:
+    """Wire payload -> dense update pytree; anything that is NOT a
+    compressed delta passes through unchanged (mixed compressed/plain
+    result lists fold uniformly). The decompression spec rides the wire,
+    so the receiver needs no configuration — shared by
+    ``Federation.decompress_update`` and the REST client."""
+    if not is_wire_payload(payload):
+        return payload
+    spec, dev_payload, n = wire_to_payload(payload)
+    flat = np.asarray(decompress_delta(spec, dev_payload, n))
+    skeleton = payload.get("skeleton")
+    if skeleton is None:
+        return flat
+    return rebuild_from_skeleton(skeleton, flat)
+
+
+class DeltaCompressor:
+    """Stateful per-process compression endpoint: one spec + named
+    error-feedback accumulators.
+
+    For callers not backed by a Federation (the REST algorithm client
+    inside a container). NOTE: the accumulators live in THIS process —
+    under ``mode="sandbox"`` each run is a fresh subprocess, so error
+    feedback only persists for inline/persistent algorithm processes;
+    prefer the Federation/engine paths when EF across rounds matters.
+    """
+
+    def __init__(self, spec: CompressorSpec):
+        spec.validate()
+        self.spec = spec
+        self._ef: dict[str, np.ndarray] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._name_locks: dict[str, threading.Lock] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        # per-INSTANCE entropy for the stochastic-rounding stream: N
+        # station processes (one DeltaCompressor each) must not draw the
+        # same U[0,1) noise per coordinate — a fixed seed would correlate
+        # their rounding errors perfectly and the cross-station average
+        # would stop shrinking as 1/N, defeating the unbiasedness
+        # rationale. This trades run-for-run reproducibility for
+        # distributed correctness; the FedAvg engine path stays fully
+        # deterministic in the caller's round key.
+        self._seed = int.from_bytes(os.urandom(4), "little")
+
+    def compress(
+        self, tree: Pytree, name: str = "update",
+        station: int | None = None,
+    ) -> Any:
+        if self.spec.identity:
+            return tree
+        skeleton = tree_skeleton(tree)
+        flat = flatten_host(tree)
+        n = int(flat.size)
+        # The EF update is a read-COMPUTE-write cycle: two concurrent
+        # same-name compresses must serialize across the whole cycle or
+        # both re-inject the same error mass (shipped twice) and one
+        # residual is silently lost. A PER-NAME mutex serializes exactly
+        # the exchanges that share an accumulator; different names (and
+        # different stations on the Federation path) still compress
+        # concurrently. _lock stays bookkeeping-only.
+        with self._lock:
+            name_lock = self._name_locks.setdefault(name, threading.Lock())
+        with name_lock:
+            with self._lock:
+                ef = self._ef.get(name)
+                seq = self._seq
+                self._seq += 1
+            if ef is None or ef.shape != (n,):
+                ef = None  # first exchange (or a reshaped model): fresh EF
+            key = jax.random.fold_in(jax.random.key(self._seed), seq)
+            payload, _, new_ef = compress_delta(
+                self.spec, flat, ef, key=key, station=station
+            )
+            new_ef = np.asarray(new_ef)
+            with self._lock:
+                self._ef[name] = new_ef
+        wire = payload_to_wire(self.spec, payload, n)
+        wire["skeleton"] = skeleton
+        return wire
+
+    def decompress(self, payload: Any) -> Any:
+        return decompress_wire_tree(payload)
+
+
+def spec_from_env(environ: Any = None) -> CompressorSpec | None:
+    """Build a CompressorSpec from ``V6T_COMPRESS`` (None when unset/off).
+
+    Format: comma-separated knobs — ``topk=0.1``, ``int8``, ``chunk=256``,
+    ``no-ef`` — e.g. ``V6T_COMPRESS=topk=0.1,int8``. How a node operator
+    arms compression for containerized algorithm code (the REST client
+    reads it at construction); ``off``/empty disables. A malformed value
+    raises at startup, not per task.
+    """
+    import os
+
+    raw = (environ or os.environ).get("V6T_COMPRESS", "").strip()
+    if not raw or raw.lower() == "off":
+        return None
+    kw: dict[str, Any] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part == "int8":
+            kw["int8"] = True
+        elif part == "no-ef":
+            kw["error_feedback"] = False
+        elif part.startswith("topk="):
+            kw["topk_ratio"] = float(part[5:])
+        elif part.startswith("chunk="):
+            kw["chunk"] = int(part[6:])
+        else:
+            raise ValueError(
+                f"V6T_COMPRESS: unknown knob {part!r} "
+                "(expected topk=F, int8, chunk=N, no-ef)"
+            )
+    spec = CompressorSpec(**kw)
+    spec.validate()
+    return spec
+
+
+# -------------------------------------------------- pytree <-> flat helpers
+# The host plane flat-packs by walking the tree in SKELETON order (dict
+# insertion order) — NOT jax.tree.leaves order (which sorts dict keys) —
+# so the skeleton the wire carries and the flat vector always agree.
+
+
+def flatten_host(tree: Pytree) -> np.ndarray:
+    """Concatenate every array leaf (skeleton walk order) into one flat
+    f32 vector — the host-plane twin of ``collectives.flatten_tree``."""
+    parts: list[np.ndarray] = []
+
+    def walk(obj: Any) -> None:
+        if isinstance(obj, dict):
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                walk(v)
+        else:
+            parts.append(np.asarray(obj, np.float32).ravel())
+
+    walk(tree)
+    if not parts:
+        raise ValueError("empty pytree")
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
+def tree_skeleton(tree: Pytree) -> Any:
+    """JSON-able structure of ``tree`` with each array leaf replaced by a
+    ``{"__leaf__", "shape", "dtype"}`` placeholder, in SKELETON walk order
+    (dict insertion order — NOT ``jax.tree.leaves`` order, which sorts
+    dict keys; pair only with ``flatten_host``, never ``flatten_tree``) —
+    how the host-plane wire payload carries the pytree structure without
+    a treedef.
+
+    Container fidelity: tuples ride a ``{"__v6t_tuple__": [...]}`` marker
+    so the round-trip gives TUPLES back (armed compression must not turn
+    a working tuple update into a list — jax.tree.map would reject the
+    structure change). NamedTuples (optax states) cannot survive a JSON
+    hop and are rejected loudly instead of silently downgraded.
+    """
+    counter = [0]
+
+    def walk(obj: Any) -> Any:
+        if isinstance(obj, dict):
+            return {k: walk(obj[k]) for k in obj}
+        if isinstance(obj, tuple):
+            if hasattr(obj, "_fields"):
+                raise TypeError(
+                    "NamedTuple containers cannot ride the compression "
+                    "wire (the class cannot be reconstructed from JSON); "
+                    "convert to a dict first"
+                )
+            return {"__v6t_tuple__": [walk(v) for v in obj]}
+        if isinstance(obj, list):
+            return [walk(v) for v in obj]
+        arr = np.asarray(obj)
+        dt = arr.dtype
+        # ml_dtypes extended types (bfloat16, fp8): dtype.str degrades to
+        # a raw void ('<V2') that np.dtype() parses back as VOID — the
+        # NAME ('bfloat16') survives the JSON hop and _resolve_dtype
+        # recovers the real type on rebuild
+        node = {
+            "__leaf__": counter[0],
+            "shape": list(arr.shape),
+            "dtype": dt.name if dt.kind == "V" else dt.str,
+        }
+        counter[0] += 1
+        return node
+
+    return walk(tree)
+
+
+def _resolve_dtype(s: str) -> np.dtype:
+    """Skeleton dtype string -> dtype: numpy's own strings directly, an
+    ml_dtypes NAME (bfloat16/float8_*) via the ml_dtypes registry — a
+    void result means the string lost its meaning, which must fail loud,
+    never silently reinterpret bytes."""
+    try:
+        dt = np.dtype(s)
+        if dt.kind != "V":
+            return dt
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
+    except (ImportError, AttributeError, TypeError) as e:
+        raise ValueError(
+            f"cannot reconstruct leaf dtype {s!r} from the skeleton"
+        ) from e
+
+
+def rebuild_from_skeleton(skeleton: Any, flat: np.ndarray) -> Any:
+    """Inverse of :func:`tree_skeleton` + flat-pack: split ``flat`` back
+    into the skeleton's leaf shapes/dtypes."""
+    sizes: list[int] = []
+
+    def collect(node: Any) -> None:
+        if isinstance(node, dict) and "__leaf__" in node:
+            sizes.append(int(np.prod(node["shape"], dtype=np.int64)))
+        elif isinstance(node, dict):
+            for v in node.values():
+                collect(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                collect(v)
+
+    collect(skeleton)
+    offsets = np.cumsum([0] + sizes)
+
+    def build(node: Any) -> Any:
+        if isinstance(node, dict) and "__leaf__" in node:
+            i = int(node["__leaf__"])
+            chunk = flat[offsets[i]:offsets[i] + sizes[i]]
+            return np.asarray(
+                chunk, dtype=_resolve_dtype(node["dtype"])
+            ).reshape(node["shape"])
+        if isinstance(node, dict) and "__v6t_tuple__" in node:
+            return tuple(build(v) for v in node["__v6t_tuple__"])
+        if isinstance(node, dict):
+            return {k: build(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return [build(v) for v in node]
+        return node
+
+    return build(skeleton)

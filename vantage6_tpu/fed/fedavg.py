@@ -33,9 +33,16 @@ from vantage6_tpu.fed.collectives import (
     fed_mean,
     fed_mean_scattered,
     flat_size,
+    flatten_stacked,
     flatten_tree,
     padded_flat_size,
     unflatten_like,
+    unflatten_stacked,
+)
+from vantage6_tpu.fed.compression import (
+    CompressorSpec,
+    compress_stacked,
+    record_round_telemetry,
 )
 
 Pytree = Any
@@ -58,9 +65,17 @@ class FedAvgSpec:
     shard_server_update: bool = False
     # On-wire dtype of the delta reduce-scatter (e.g. jnp.bfloat16 halves
     # collective bytes). Master params, moments and post-scatter math stay
-    # f32 — see docs/sharded_update.md for the accuracy caveats. Only used
-    # when shard_server_update=True.
+    # f32 — see docs/sharded_update.md for the accuracy caveats. Used by
+    # the scattered exchange (shard_server_update=True) and, when a
+    # compressor is set, as the pre-quantization cast (cast, THEN
+    # quantize — docs/compression.md composition order).
     comm_dtype: Any = None
+    # Gradient compression of the per-station delta uplink (CompressorSpec,
+    # docs/compression.md): stochastic int8 and/or top-k with per-station
+    # error-feedback accumulators carried in the optimizer state. The
+    # aggregation consumes the DECOMPRESSED deltas, so this composes with
+    # both the replicated and the scattered (ZeRO-1) server update.
+    compressor: CompressorSpec | None = None
 
 
 class FedAvg:
@@ -69,6 +84,13 @@ class FedAvg:
     def __init__(self, mesh: FederationMesh, spec: FedAvgSpec):
         self.mesh = mesh
         self.spec = spec
+        if spec.compressor is not None:
+            spec.compressor.validate()
+        # an identity compressor (no top-k, no int8) is a no-op: skip the
+        # flat-pack round-trip entirely rather than paying it for nothing
+        self._compressing = (
+            spec.compressor is not None and not spec.compressor.identity
+        )
         self.server_opt = spec.server_optimizer or optax.sgd(1.0)
         # NOTE: no buffer donation here — callers legitimately reuse params
         # across round() calls (e.g. ablations from one init); the scan in
@@ -141,20 +163,70 @@ class FedAvg:
             replicated_args=(params, round_key),
         )
         weights = counts * mask
+        # Gradient compression at the delta-exchange boundary: the
+        # aggregation below consumes the DECOMPRESSED per-station deltas —
+        # exactly what a real server reconstructs from each station's
+        # compressed uplink — and the per-station error-feedback
+        # accumulators ride the optimizer-state carry to the next round.
+        ef = None
+        if self._compressing:
+            server_state = opt_state["server"]
+            deltas, ef = self._compress_deltas(
+                deltas, opt_state["ef"], round_key, mask
+            )
+        else:
+            server_state = opt_state
         if self.spec.shard_server_update:
-            params, opt_state = self._sharded_server_update(
-                params, opt_state, deltas, weights
+            params, server_state = self._sharded_server_update(
+                params, server_state, deltas, weights
             )
         else:
             mean_delta = fed_mean(deltas, weights=weights)
             # Server update on the pseudo-gradient (negative mean delta).
             pseudo_grad = jax.tree.map(lambda d: -d, mean_delta)
-            updates, opt_state = self.server_opt.update(
-                pseudo_grad, opt_state, params
+            updates, server_state = self.server_opt.update(
+                pseudo_grad, server_state, params
             )
             params = optax.apply_updates(params, updates)
         round_loss = fed_mean(losses, weights=weights)
-        return params, opt_state, round_loss
+        new_state = (
+            {"server": server_state, "ef": ef}
+            if self._compressing
+            else server_state
+        )
+        return params, new_state, round_loss
+
+    def _compress_deltas(
+        self, deltas: Pytree, ef: jax.Array, round_key: jax.Array,
+        mask: jax.Array,
+    ) -> tuple[Pytree, jax.Array]:
+        """Per-station compress -> decompress of the delta uplink (the
+        flat-pack seam): error feedback re-injected before compressing,
+        ``comm_dtype`` applied as the pre-quantization cast (cast, then
+        quantize). Returns the reconstructed deltas + new EF [S, N].
+        Pure/traced — runs inside the round program; wire accounting
+        happens host-side in round()/run_rounds().
+
+        A masked-out station never ships anything, so its accumulator
+        must WAIT, not update: under SPMD it computes a (fictional) delta
+        like everyone else, but both that delta and the would-be shipped
+        mass are discarded — its EF row carries over unchanged (the
+        docs/compression.md "mass is never lost" contract;
+        tests/test_compression.py::test_masked_station_ef_waits)."""
+        template = jax.tree.map(lambda x: x[0], deltas)
+        flat = flatten_stacked(deltas)
+        # a key stream disjoint from _local_update's fold_in(key, station):
+        # station ids are < n_stations, 2**31 - 1 never is
+        keys = jax.random.split(
+            jax.random.fold_in(round_key, 2**31 - 1), self.mesh.n_stations
+        )
+        _, hat, new_ef = compress_stacked(
+            self.spec.compressor, flat, ef, keys,
+            cast_dtype=self.spec.comm_dtype,
+        )
+        participating = (mask != 0).reshape(-1, 1)
+        new_ef = jnp.where(participating, new_ef, ef)
+        return unflatten_stacked(template, hat), new_ef
 
     def _sharded_server_update(
         self, params: Pytree, opt_state: Any, deltas: Pytree,
@@ -200,20 +272,31 @@ class FedAvg:
         With ``shard_server_update`` the state is built over the FLAT padded
         f32 param vector (moments are [N_pad] arrays, placed sharded over
         the station axis) — checkpoints of the two modes are therefore NOT
-        interchangeable.
+        interchangeable. With a ``compressor``, the returned state is a
+        ``{"server": <optimizer state>, "ef": [S, N]}`` dict carrying each
+        station's zero-initialized error-feedback accumulator (sharded over
+        the station axis) — again not checkpoint-compatible with the
+        uncompressed modes.
         """
         if self.spec.shard_server_update:
             flat = flatten_tree(params)
             n_pad = padded_flat_size(flat.size, self.mesh.station_axis_size)
             flat = jnp.pad(flat, (0, n_pad - flat.size))
             state = self.server_opt.init(flat)
-            return jax.tree.map(
+            state = jax.tree.map(
                 lambda x: jax.device_put(x, self.mesh.station_sharding())
                 if getattr(x, "ndim", 0) == 1 and x.shape == (n_pad,)
                 else x,
                 state,
             )
-        return self.server_opt.init(params)
+        else:
+            state = self.server_opt.init(params)
+        if self._compressing:
+            ef = jnp.zeros(
+                (self.mesh.n_stations, flat_size(params)), jnp.float32
+            )
+            return {"server": state, "ef": self.mesh.shard_stacked(ef)}
+        return state
 
     def round(
         self,
@@ -228,9 +311,37 @@ class FedAvg:
         """One federated round. Returns (params, opt_state, mean_loss)."""
         if mask is None:
             mask = jnp.ones_like(counts)
+        self._record_wire(params)
         return self._round(
             params, opt_state, stacked_x, stacked_y, counts, mask, key
         )
+
+    def _record_wire(self, params: Pytree, n_rounds: int = 1) -> None:
+        """Host-side wire accounting for the compressed delta uplink
+        (``v6t_compress_*`` series) — metadata-only, never touches device
+        data and never runs inside the traced round."""
+        if self._compressing:
+            record_round_telemetry(
+                self.spec.compressor, flat_size(params),
+                self.mesh.n_stations, rounds=n_rounds,
+            )
+
+    def compression_stats(self, params: Pytree) -> dict[str, Any] | None:
+        """Static per-round wire accounting of the delta uplink: raw vs
+        compressed bytes across all stations + the reduction ratio (the
+        bench's acceptance numbers). None without an effective compressor.
+        Metadata-only — safe to call around a compiled run."""
+        if not self._compressing:
+            return None
+        n = flat_size(params)
+        spec = self.spec.compressor
+        s = self.mesh.n_stations
+        return {
+            "n_params": n,
+            "raw_bytes_per_round": 4 * n * s,
+            "wire_bytes_per_round": spec.wire_nbytes(n) * s,
+            "reduction": round(spec.ratio(n), 2),
+        }
 
     def run_rounds(
         self,
@@ -263,6 +374,7 @@ class FedAvg:
             mask = jnp.ones_like(counts)
         if opt_state is None:
             opt_state = self.init(params)
+        self._record_wire(params, n_rounds=n_rounds)
         run = self._run_donating if donate else self._run
         return run(
             params, opt_state, stacked_x, stacked_y, counts, mask, key,

@@ -127,6 +127,22 @@ class Federation:
         # run ids queued/executing on the pool (NOT the same as PENDING:
         # a PENDING run on an offline station is owed, not in flight)
         self._inflight_runs: set[int] = set()  # guarded-by: _inflight_lock
+        # --------------------------------------------- gradient compression
+        # Host-plane delta compression (docs/compression.md): ONE
+        # DeltaCompressor holds every station's error-feedback accumulator
+        # (keyed "station:name" — each station's compression error is
+        # re-injected into ITS next update). Its internal lock guards the
+        # bookkeeping; pool workers for different stations compress
+        # concurrently, and the per-station FIFO guarantees one station
+        # never races itself.
+        self.compressor = config.compressor
+        self._delta_compressor = None
+        if self.compressor is not None and not getattr(
+            self.compressor, "identity", False
+        ):
+            from vantage6_tpu.fed.compression import DeltaCompressor
+
+            self._delta_compressor = DeltaCompressor(self.compressor)
         self._inflight_lock = threading.Lock()
         self._stacked_lock = threading.Lock()   # _stacked_cache builds
         self._identity_lock = threading.Lock()  # lazy RSA keygen
@@ -822,6 +838,39 @@ class Federation:
                     jnp.bfloat16 if agg_mode == "scattered_bf16" else None
                 ),
             )
+
+    # ------------------------------------------------- gradient compression
+    def compress_update(
+        self, station: int, tree: Any, name: str = "update"
+    ) -> Any:
+        """Station-side half of the host-plane delta exchange: compress
+        ``tree`` (a pytree of float arrays — a model delta) under the
+        federation's configured compressor, with THIS station's
+        error-feedback accumulator re-injected first and updated after
+        (keyed ``(station, name)`` so independent exchanges don't share
+        error state). Returns a wire-serializable payload whose sparse
+        half is a first-class v2 buffer (`serialization.SparseVector`);
+        legacy v1 peers receive it densified by the existing wire_format
+        capability detection. Recorded as a ``device.compress`` span and
+        counted in the ``v6t_compress_*`` series.
+
+        A pass-through when no (effective) compressor is configured, so
+        algorithm code can leave the call in place unconditionally.
+        """
+        dc = self._delta_compressor
+        if dc is None:
+            return tree
+        return dc.compress(tree, name=f"{station}:{name}", station=station)
+
+    def decompress_update(self, payload: Any) -> Any:
+        """Server-side half: materialize the dense update pytree from a
+        `compress_update` wire payload (``device.decompress`` span). A
+        pass-through for anything that is not a compressed payload, so
+        mixed compressed/uncompressed result lists fold uniformly. The
+        decompression spec rides the wire — no config needed here."""
+        from vantage6_tpu.fed.compression import decompress_wire_tree
+
+        return decompress_wire_tree(payload)
 
     # ------------------------------------------------------ elastic recovery
     def _drain_pending(self, station: int) -> None:

@@ -490,12 +490,38 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
     exec_by_station: dict[str, float] = {}
     traces: set[str] = set()
     errors = 0
+    exec_total = 0.0
+    by_id = {
+        (sp["trace_id"], sp.get("span_id")): sp
+        for sp in spans
+        if sp.get("span_id")
+    }
+
+    def has_exec_ancestor(sp: dict[str, Any]) -> bool:
+        # nested exec spans (a central's runner.exec stays open while its
+        # partials record their own) must not double-count wall-clock in
+        # exec_total — only TOP-LEVEL exec spans contribute
+        cur, hops = sp, 0
+        while hops < 1000:  # malformed-parent-chain guard
+            pid = cur.get("parent_id")
+            if not pid:
+                return False
+            parent = by_id.get((cur["trace_id"], pid))
+            if parent is None:
+                return False
+            if parent.get("kind") == "exec":
+                return True
+            cur, hops = parent, hops + 1
+        return False
+
     for sp in spans:
         traces.add(sp["trace_id"])
         by_name.setdefault(sp["name"], []).append(sp.get("dur", 0.0))
         if sp.get("status") == "error":
             errors += 1
         if sp.get("kind") == "exec":
+            if not has_exec_ancestor(sp):
+                exec_total += sp.get("dur", 0.0)
             attrs = sp.get("attrs") or {}
             station = attrs.get("organization_id")
             if station is None:
@@ -526,12 +552,33 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
                 for k, v in sorted(exec_by_station.items())
             },
         }
+    # gradient-compression call-out (docs/compression.md): how much of the
+    # round the device.compress/decompress ops cost, against the exec
+    # total — the "<10% of round time" acceptance number, read directly
+    # off a trace instead of re-derived per bench
+    compression = None
+    c = table.get("device.compress")
+    d = table.get("device.decompress")
+    if c or d:
+        total_ms = (c or {}).get("total_ms", 0.0) + (d or {}).get(
+            "total_ms", 0.0
+        )
+        compression = {
+            "compress_total_ms": (c or {}).get("total_ms", 0.0),
+            "decompress_total_ms": (d or {}).get("total_ms", 0.0),
+            "pct_of_exec": (
+                round(100.0 * total_ms / (exec_total * 1e3), 2)
+                if exec_total > 0
+                else None
+            ),
+        }
     return {
         "n_spans": len(spans),
         "n_traces": len(traces),
         "n_errors": errors,
         "spans": table,
         "straggler": straggler,
+        "compression": compression,
     }
 
 

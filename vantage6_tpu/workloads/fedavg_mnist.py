@@ -55,6 +55,7 @@ def make_engine(
     server_optimizer: optax.GradientTransformation | None = None,
     shard_server_update: bool = False,
     comm_dtype: Any = None,
+    compressor: Any = None,
 ) -> FedAvg:
     return FedAvg(
         mesh,
@@ -66,6 +67,7 @@ def make_engine(
             server_optimizer=server_optimizer,
             shard_server_update=shard_server_update,
             comm_dtype=comm_dtype,
+            compressor=compressor,
         ),
     )
 
