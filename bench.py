@@ -121,11 +121,30 @@ CP_WIDTH = 2          # organizations targeted per task
 # measures (< 5% tasks/sec overhead). The traced arm additionally proves
 # one task's trace covers create→dispatch→claim→exec→report→aggregate,
 # exports valid Perfetto trace_event JSON, and parses GET /metrics.
-OBS_TIMEOUT_S = 420
+OBS_TIMEOUT_S = 540
 OBS_DAEMONS = 4
 OBS_TASKS = 24
-OBS_REPS = 2          # off/on pairs (alternated)
+OBS_REPS = 2          # off/trace/ops triples (alternated)
 OBS_OVERHEAD_PCT = 5.0
+# watchdog/flight extension (ops-plane PR): a THIRD alternated arm runs
+# the full ops plane (tracing + watchdog at an operator cadence +
+# structured JSON logging + flight-recorder taps). overhead_pct keeps its
+# PR-5 meaning (tracing vs bare); ops_overhead_pct isolates what the ops
+# plane adds ON TOP of tracing, against the same <5% budget. After the
+# overhead arms, a fault-injection smoke kills one daemon mid-round and
+# wedges one run past its deadline: the watchdog must raise daemon_lapsed
+# + stuck_run within one evaluation interval, /api/health must flip to
+# degraded, and a flight dump must doctor into a trace-correlated
+# timeline naming the stuck run.
+OBS_WD_ARM_INTERVAL = 2.0  # watchdog cadence in the ON overhead arm — a
+                           # fast-but-plausible operator setting (default
+                           # 5 s); the whole topology shares one python
+                           # process in this bench, so the smoke's 0.4 s
+                           # detection cadence would bill GIL contention
+                           # no multi-process deployment pays
+OBS_WD_INTERVAL = 0.4      # watchdog eval cadence in the fault smoke
+OBS_WD_DEADLINE = 1.0      # stuck-run deadline in the smoke
+OBS_WD_PING_WINDOW = 1.2   # daemon_lapsed window in the smoke
 # wire_format leg (binary wire PR): v1 JSON+base64 vs v2 framed-binary
 # (de)serialization throughput + on-wire bytes on model-weight pytrees and a
 # DataFrame stats table, plus single-pass broadcast encryption cost when the
@@ -1009,16 +1028,24 @@ def worker_controlplane() -> None:
 
 
 def worker_observability() -> None:
-    """observability leg: distributed tracing ON vs OFF, same topology.
+    """observability leg: bare vs tracing vs full ops plane, alternated.
 
-    The guardrail for the tracing PR: OBS_DAEMONS batched daemons + one
-    server, OBS_TASKS small partial tasks per arm, arms alternated
-    (off, on, off, on, ...) and compared best-of so a background load
-    spike on the host doesn't masquerade as tracing overhead. The traced
-    arm also asserts the OBSERVABILITY acceptance: one task's trace
-    covers client create → server dispatch → daemon claim → runner exec
-    → result upload → aggregation, exports valid Perfetto trace_event
-    JSON, and the server's /metrics parses with the absorbed series.
+    The guardrail for the tracing PR, extended by the watchdog PR: three
+    arms per rep — "off" (bare), "trace" (distributed tracing, the PR-5
+    configuration, so overhead_pct keeps its historical meaning), "ops"
+    (tracing + watchdog at an operator cadence + structured JSON logging
+    + flight taps). Arms alternate and compare best-of so a host-load
+    spike doesn't masquerade as instrumentation overhead;
+    ops_overhead_pct (ops vs trace) is the watchdog PR's <5% acceptance.
+    The traced arm also asserts the OBSERVABILITY acceptance: one task's
+    trace covers client create → server dispatch → daemon claim → runner
+    exec → result upload → aggregation, exports valid Perfetto
+    trace_event JSON, and the server's /metrics parses with the absorbed
+    series. A fault-injection smoke then proves the watchdog DETECTS: a
+    daemon killed mid-round and a run wedged past its deadline must raise
+    their alerts within one evaluation interval, flip /api/health to
+    degraded, and produce a flight dump that tools/doctor.py renders as a
+    trace-correlated timeline naming the stuck run.
     """
     _worker_setup()
     import tempfile
@@ -1028,10 +1055,12 @@ def worker_observability() -> None:
 
     from vantage6_tpu.client import UserClient
     from vantage6_tpu.common.enums import TaskStatus
+    from vantage6_tpu.common.log import disable_json_sink, enable_json_sink
     from vantage6_tpu.node.daemon import NodeDaemon
     from vantage6_tpu.runtime.tracing import (
         TRACER, summarize, to_trace_events,
     )
+    from vantage6_tpu.runtime.watchdog import WATCHDOG
     from vantage6_tpu.server.app import ServerApp
 
     n_daemons = int(os.environ.get("BENCH_OBS_DAEMONS", str(OBS_DAEMONS)))
@@ -1048,21 +1077,22 @@ def worker_observability() -> None:
         ).to_csv(path, index=False)
         csvs.append(path)
 
-    def arm(tracing_on: bool, arm_tag: str) -> dict:
-        TRACER.configure(enabled=tracing_on, sample=1.0)
-        TRACER.clear()
+    def boot_stack(tag: str, n: int, **daemon_kw):
+        """Server + authed root client + n orgs/nodes/daemons — the ONE
+        topology bring-up shared by the overhead arms and the fault
+        smoke, so a daemon-construction change can't silently leave the
+        smoke testing a different stack than the arms measure."""
         srv = ServerApp()
         srv.ensure_root(password="rootpass123")
         http = srv.serve(port=0, background=True)
         client = UserClient(http.url)
         client.authenticate("root", "rootpass123")
         orgs = [
-            client.organization.create(name=f"obs-{arm_tag}-{i:02d}")
-            for i in range(n_daemons)
+            client.organization.create(name=f"{tag}-{i:02d}")
+            for i in range(n)
         ]
         collab = client.collaboration.create(
-            name=f"obs-{arm_tag}",
-            organization_ids=[o["id"] for o in orgs],
+            name=tag, organization_ids=[o["id"] for o in orgs],
         )
         daemons = []
         for i, org in enumerate(orgs):
@@ -1077,10 +1107,31 @@ def worker_observability() -> None:
                     {"label": "default", "type": "csv", "uri": csvs[i]}
                 ],
                 mode="inline",
-                poll_interval=0.25,
+                **daemon_kw,
             )
             d.start()
             daemons.append(d)
+        return srv, http, client, orgs, collab, daemons
+
+    def arm(mode: str, arm_tag: str) -> dict:
+        # three alternated arms: "off" (no instrumentation), "trace"
+        # (distributed tracing — the PR-5 configuration, so overhead_pct
+        # keeps its historical meaning), "ops" (tracing + watchdog at an
+        # operator cadence + JSON logging + flight taps — the full ops
+        # plane; ops_overhead_pct vs the trace arm isolates what THIS
+        # layer adds)
+        tracing_on = mode != "off"
+        TRACER.configure(enabled=tracing_on, sample=1.0)
+        TRACER.clear()
+        if mode == "ops":
+            WATCHDOG.configure(interval=OBS_WD_ARM_INTERVAL)
+            enable_json_sink(os.path.join(tmp, f"log-{arm_tag}.jsonl"))
+        else:
+            WATCHDOG.configure(interval=60.0)  # effectively idle
+            disable_json_sink()
+        srv, http, client, orgs, collab, daemons = boot_stack(
+            f"obs-{arm_tag}", n_daemons, poll_interval=0.25,
+        )
         org_ids = [o["id"] for o in orgs]
         parity = True
         last_trace = None
@@ -1163,32 +1214,181 @@ def worker_observability() -> None:
         srv.close()
         return out
 
+    def fault_smoke() -> dict:
+        """Kill one daemon mid-round + wedge one run past its deadline;
+        measure detection latency, the health flip, and the post-mortem
+        path (flight dump → doctor timeline naming the stuck run)."""
+        import subprocess
+
+        from vantage6_tpu.common.flight import FLIGHT, read_bundle
+
+        TRACER.configure(enabled=True, sample=1.0)
+        # fast eval cadence now, but RELAXED thresholds until the healthy
+        # baseline round is in the books — on a loaded host a >1s healthy
+        # round against the smoke deadlines would raise alerts before any
+        # fault is injected, poisoning healthy_status
+        WATCHDOG.configure(
+            interval=OBS_WD_INTERVAL,
+            run_deadline_s=300.0,
+            ping_window_s=60.0,
+        )
+        enable_json_sink(os.path.join(tmp, "log-fault.jsonl"))
+        FLIGHT.clear()
+        srv, http, client, orgs, collab, daemons = boot_stack(
+            "obs-fault", 2, poll_interval=0.1, sync_interval=2.0,
+            ping_interval=0.3, event_wait=0.5,
+        )
+        out: dict = {}
+        try:
+            # one healthy round first: traces + flight content to dump
+            t_ok = client.task.create(
+                collaboration=collab["id"],
+                organizations=[o["id"] for o in orgs],
+                image=image,
+                input_={"method": "partial_average",
+                        "kwargs": {"column": "age"}},
+            )
+            client.wait_for_results(t_ok["id"], interval=0.1, timeout=60.0)
+            out["healthy_status"] = client.util.health()["status"]
+            # healthy evidence recorded — NOW arm the smoke thresholds
+            WATCHDOG.configure(
+                run_deadline_s=OBS_WD_DEADLINE,
+                ping_window_s=OBS_WD_PING_WINDOW,
+            )
+            # FAULT 1 — daemon killed mid-round: stop the victim's threads
+            # WITHOUT the offline handshake (a crash, not a shutdown); its
+            # node stays "online" at the server and the pings stop
+            victim = daemons[1]
+            victim._stop.set()
+            # a real crash: listen/sync threads die, the worker pool dies,
+            # NO offline handshake reaches the server. Join before the
+            # wedge task exists so no victim thread can pick it up.
+            for th in (victim._thread, victim._sync_thread):
+                if th is not None:
+                    th.join(timeout=10)
+            victim._pool.shutdown(wait=False, cancel_futures=True)
+            # FAULT 2 — wedged run: a task for the dead daemon's org,
+            # claimed ACTIVE (the victim's last act before dying) and
+            # never finished
+            t_bad = client.task.create(
+                collaboration=collab["id"],
+                organizations=[orgs[1]["id"]],
+                image=image,
+                input_={"method": "partial_average",
+                        "kwargs": {"column": "age"}},
+            )
+            runs = client.run.from_task(t_bad["id"])
+            rid = runs[0]["id"]
+            victim.request(
+                "PATCH", f"run/{rid}",
+                {"status": "active", "started_at": time.time()},
+            )
+            wedged_at = time.monotonic()
+            want = {"stuck_run", "daemon_lapsed"}
+            seen: set = set()
+            deadline = wedged_at + OBS_WD_DEADLINE + 12.0
+            while time.monotonic() < deadline and not want <= seen:
+                seen = {
+                    a["rule"] for a in client.util.alerts()["active"]
+                }
+                if want <= seen:
+                    break
+                time.sleep(0.1)
+            detect_s = time.monotonic() - wedged_at
+            # "within one evaluation interval" of the deadline passing
+            # (+1 interval of poll slack for this probe loop itself)
+            budget_s = OBS_WD_DEADLINE + 2 * OBS_WD_INTERVAL + 0.5
+            health = client.util.health()
+            dump = client.util.debug_dump()
+            doctor = subprocess.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "doctor.py",
+                ), dump["path"], "--trace", t_bad["trace_id"][:8]],
+                capture_output=True, text=True, timeout=60,
+            )
+            # the torn-tail-tolerant reader, not raw json.loads — a dump
+            # racing a writer must still yield the records that DID land
+            bundle = read_bundle(dump["path"])
+            bundle_spans = [
+                r for r in bundle if r.get("type") == "span"
+                and r.get("trace_id") == t_bad["trace_id"]
+            ]
+            bundle_logs = [
+                r for r in bundle if r.get("type") == "log"
+                and r.get("trace_id") == t_bad["trace_id"]
+            ]
+            out.update({
+                "alerts_seen": sorted(seen),
+                "alerts_ok": want <= seen,
+                "detect_s": round(detect_s, 2),
+                "detect_budget_s": round(budget_s, 2),
+                "within_one_interval": detect_s <= budget_s,
+                "health_degraded": health["status"] == "degraded",
+                "failing_components_or_alerts": {
+                    "alerts": health.get("alerts"),
+                },
+                "flight_bundle": dump["path"],
+                "bundle_spans_for_stuck_task": len(bundle_spans),
+                "bundle_trace_correlated_logs": len(bundle_logs),
+                "doctor_ok": (
+                    doctor.returncode == 0
+                    and f"run {rid}" in doctor.stdout
+                    and "stuck_run" in doctor.stdout
+                ),
+                "stuck_run_id": rid,
+            })
+        finally:
+            for d in daemons:
+                try:
+                    d.stop()
+                except Exception:
+                    pass
+            http.stop()
+            srv.close()
+        return out
+
     try:
-        offs, ons = [], []
+        offs, ons, opss = [], [], []
         traced: dict = {}
         for rep in range(max(1, int(os.environ.get(
             "BENCH_OBS_REPS", str(OBS_REPS)
         )))):
-            offs.append(arm(False, f"off{rep}"))
-            on = arm(True, f"on{rep}")
+            offs.append(arm("off", f"off{rep}"))
+            on = arm("trace", f"on{rep}")
             traced = on  # keep the freshest traced-arm evidence
             ons.append(on)
+            opss.append(arm("ops", f"ops{rep}"))
+        watchdog_smoke = fault_smoke()
     finally:
         TRACER.configure(enabled=True, sample=1.0)
+        disable_json_sink()
+        WATCHDOG.configure(
+            interval=5.0, run_deadline_s=300.0, ping_window_s=60.0,
+        )
     best_off = max(a["tasks_per_sec"] for a in offs)
     best_on = max(a["tasks_per_sec"] for a in ons)
+    best_ops = max(a["tasks_per_sec"] for a in opss)
     overhead_pct = round(100.0 * (best_off - best_on) / best_off, 2)
+    # what the WATCHDOG PR adds on top of tracing (the "<5% watchdog +
+    # JSON logging" acceptance): ops arm vs trace arm, best-of each
+    ops_overhead_pct = round(100.0 * (best_on - best_ops) / best_on, 2)
     print(json.dumps({
         "n_daemons": n_daemons,
         "n_tasks": n_tasks,
         "reps": len(offs),
         "tasks_per_sec_tracing_off": best_off,
         "tasks_per_sec_tracing_on": best_on,
+        "tasks_per_sec_ops_plane": best_ops,
         "overhead_pct": overhead_pct,
         "overhead_ok": overhead_pct < OBS_OVERHEAD_PCT,
+        "ops_overhead_pct": ops_overhead_pct,
+        "ops_overhead_ok": ops_overhead_pct < OBS_OVERHEAD_PCT,
         "overhead_budget_pct": OBS_OVERHEAD_PCT,
+        "ops_plane_in_ops_arm": ["tracing", "watchdog", "json_logging",
+                                 "flight_taps"],
         "parity_ok": all(
-            a["parity_ok"] for a in offs + ons
+            a["parity_ok"] for a in offs + ons + opss
         ),
         "trace": {
             k: traced.get(k)
@@ -1197,6 +1397,7 @@ def worker_observability() -> None:
                 "missing_spans", "perfetto_ok", "metrics_ok", "per_hop",
             )
         },
+        "watchdog": watchdog_smoke,
     }))
 
 

@@ -1,0 +1,1074 @@
+"""Live health watchdog + flight recorder + crash forensics (ISSUE 8).
+
+Covers:
+- every default alert rule against synthetic RuleContexts (fires on the
+  fault, stays quiet on the healthy twin);
+- the Watchdog engine: raise/clear transitions with dedup, telemetry
+  counters/gauges, the alert span landing on the affected task's OWN
+  trace, fail-soft feeds, the rule-audit contract check_collect enforces;
+- health verdict: component self-checks (ok → degraded → ok), critical
+  alerts degrading, the watchdog's own staleness check;
+- the flight recorder: bounded rings, log/span taps with trace
+  correlation, bundle dump + torn-tail-tolerant read_bundle;
+- torn-tail tolerance of read_spans/read_jsonl under a CONCURRENT writer
+  (satellite);
+- server API: /api/health verdict + components, /api/alerts payload,
+  POST /api/debug/dump auth + bundle;
+- the fault-injection acceptance smoke (wedged ACTIVE run + lapsed node
+  → alerts within one evaluation, degraded health, doctor timeline
+  naming the stuck run);
+- daemon event-poll backoff: one WARNING per failure streak +
+  v6t_daemon_backoff_total (satellite);
+- tools/doctor.py (digest, merge order, --trace filter) and
+  tools/bench_trend.py (trend table, regression exit, platform split,
+  tail-regex fallback).
+"""
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from vantage6_tpu.common.flight import FLIGHT, FlightRecorder, read_bundle
+from vantage6_tpu.common.log import (
+    disable_json_sink,
+    enable_json_sink,
+    setup_logging,
+)
+from vantage6_tpu.common.telemetry import KNOWN_METRICS, REGISTRY
+from vantage6_tpu.runtime.metrics import read_jsonl
+from vantage6_tpu.runtime.tracing import TRACER, parse_traceparent, read_spans
+from vantage6_tpu.runtime.watchdog import (
+    DEFAULT_RULES,
+    RULE_CATALOG,
+    SEVERITIES,
+    WATCHDOG,
+    AlertRule,
+    RuleContext,
+    Watchdog,
+    default_rules,
+)
+from vantage6_tpu.server.app import ServerApp
+
+
+@pytest.fixture()
+def tracer():
+    TRACER.configure(enabled=True, sample=1.0, sink=None)
+    TRACER.clear()
+    yield TRACER
+    TRACER.configure(enabled=True, sample=1.0, sink=None)
+
+
+@pytest.fixture()
+def wd():
+    """A fresh engine instance (not the process singleton) so alert state
+    never bleeds between tests."""
+    return Watchdog(interval=60.0)
+
+
+def ctx(snapshot=None, history=None, feeds=None, config=None, now=None):
+    from collections import deque
+
+    w = Watchdog(interval=60.0)
+    cfg = dict(w.config)
+    cfg.update(config or {})
+    return RuleContext(
+        snapshot or {},
+        {k: deque(v) for k, v in (history or {}).items()},
+        feeds or {},
+        cfg,
+        now if now is not None else time.time(),
+    )
+
+
+def rule(name):
+    return next(r for r in DEFAULT_RULES if r.name == name)
+
+
+# ---------------------------------------------------------------- the rules
+class TestRules:
+    def test_stuck_run_fires_past_deadline(self):
+        now = time.time()
+        c = ctx(
+            feeds={"f": {"runs": [{
+                "run_id": 7, "task_id": 3, "status": "active",
+                "started_at": now - 100, "traceparent": "tp",
+            }]}},
+            config={"run_deadline_s": 5.0}, now=now,
+        )
+        found = rule("stuck_run").check(c)
+        assert len(found) == 1
+        assert "run 7" in found[0]["message"]
+        assert found[0]["labels"] == {"run_id": 7, "task_id": 3}
+        assert found[0]["traceparent"] == "tp"
+
+    def test_stuck_run_quiet_within_deadline_and_for_pending(self):
+        now = time.time()
+        c = ctx(
+            feeds={"f": {"runs": [
+                {"run_id": 1, "task_id": 1, "status": "active",
+                 "started_at": now - 1},
+                {"run_id": 2, "task_id": 1, "status": "pending",
+                 "assigned_at": now - 9999},
+            ]}},
+            config={"run_deadline_s": 5.0}, now=now,
+        )
+        assert rule("stuck_run").check(c) == []
+
+    def test_stuck_run_recent_status_event_defers(self):
+        now = time.time()
+        c = ctx(
+            feeds={"f": {"runs": [{
+                "run_id": 1, "task_id": 1, "status": "active",
+                "started_at": now - 100, "last_event_ts": now - 1,
+            }]}},
+            config={"run_deadline_s": 5.0}, now=now,
+        )
+        assert rule("stuck_run").check(c) == []
+
+    def test_daemon_lapsed(self):
+        now = time.time()
+        c = ctx(
+            feeds={"f": {"nodes": [
+                {"node_id": 1, "name": "a", "status": "online",
+                 "last_seen_at": now - 100},
+                {"node_id": 2, "name": "b", "status": "online",
+                 "last_seen_at": now - 1},
+                {"node_id": 3, "name": "c", "status": "offline",
+                 "last_seen_at": now - 9999},  # gracefully offline: fine
+            ]}},
+            config={"ping_window_s": 10.0}, now=now,
+        )
+        found = rule("daemon_lapsed").check(c)
+        assert [f["labels"]["node_id"] for f in found] == [1]
+
+    def test_straggler_needs_repetition_and_ratio(self):
+        def rounds(station, n, ratio):
+            return [
+                {"task_id": i, "straggler_station": station,
+                 "max_exec_s": ratio, "mean_exec_s": 1.0, "n": 4}
+                for i in range(n)
+            ]
+
+        cfg = {"straggler_rounds": 3, "straggler_ratio": 3.0,
+               "straggler_window": 8}
+        assert rule("straggler_station").check(
+            ctx(feeds={"f": {"rounds": rounds(2, 3, 5.0)}}, config=cfg)
+        )[0]["labels"] == {"station": 2}
+        # only twice: quiet
+        assert rule("straggler_station").check(
+            ctx(feeds={"f": {"rounds": rounds(2, 2, 5.0)}}, config=cfg)
+        ) == []
+        # often but mild skew: quiet
+        assert rule("straggler_station").check(
+            ctx(feeds={"f": {"rounds": rounds(2, 8, 1.5)}}, config=cfg)
+        ) == []
+
+    def test_queue_buildup_requires_sustained_backlog(self):
+        now = time.time()
+        cfg = {"queue_factor": 4.0, "queue_sustain_evals": 2}
+        snap = {"v6t_executor_capacity": 2.0,
+                "v6t_executor_inflight_items": 100.0}
+        sustained = {
+            "v6t_executor_inflight_items": [(now - 1, 100.0), (now, 100.0)]
+        }
+        spike = {
+            "v6t_executor_inflight_items": [(now - 1, 0.0), (now, 100.0)]
+        }
+        assert rule("queue_buildup").check(
+            ctx(snapshot=snap, history=sustained, config=cfg)
+        )
+        assert rule("queue_buildup").check(
+            ctx(snapshot=snap, history=spike, config=cfg)
+        ) == []
+        # "sustained" is a wall-clock claim: two qualifying samples landed
+        # milliseconds apart (an ad-hoc evaluate() racing the loop tick)
+        # must NOT count, while the same samples a real interval apart do
+        timed_cfg = {**cfg, "eval_interval_s": 5.0}
+        burst = {
+            "v6t_executor_inflight_items": [(now - 0.01, 100.0),
+                                            (now, 100.0)]
+        }
+        assert rule("queue_buildup").check(
+            ctx(snapshot=snap, history=burst, config=timed_cfg)
+        ) == []
+        spaced = {
+            "v6t_executor_inflight_items": [(now - 5.0, 100.0),
+                                            (now, 100.0)]
+        }
+        assert rule("queue_buildup").check(
+            ctx(snapshot=snap, history=spaced, config=timed_cfg)
+        )
+
+    def test_event_cursor_lag_on_truncated_fetches(self):
+        """Fires on ACTUAL truncated fetches, not on eviction alone —
+        a busy server's full ring evicts on every emit as steady state."""
+        now = time.time()
+        snap = {"v6t_event_hub_evicted_through": 9000.0,
+                "v6t_event_hub_cursor": 5000.0}
+        lagging = {
+            "v6t_event_truncated_total": [(now - 1, 2.0), (now, 5.0)]
+        }
+        # eviction churns but nobody asked for lost history: stays quiet
+        healthy_churn = {
+            "v6t_event_truncated_total": [(now - 1, 5.0), (now, 5.0)],
+            "v6t_event_hub_evicted_through": [(now - 1, 100.0),
+                                              (now, 9000.0)],
+        }
+        fired = rule("event_cursor_lag").check(
+            ctx(snapshot=snap, history=lagging)
+        )
+        assert fired and "truncated" in fired[0]["message"]
+        assert rule("event_cursor_lag").check(
+            ctx(snapshot=snap, history=healthy_churn)
+        ) == []
+        # the FIRST truncation of a process lifetime: the engine zero-fills
+        # the absent counter's history, so the rule sees 0 -> 1 and fires —
+        # while a count that predates the watchdog (single sample, no
+        # zero baseline recorded after it) must NOT read as a fresh jump
+        first_ever = {"v6t_event_truncated_total": [(now - 1, 0.0),
+                                                    (now, 1.0)]}
+        assert rule("event_cursor_lag").check(
+            ctx(snapshot=snap, history=first_ever)
+        )
+        preexisting = {"v6t_event_truncated_total": [(now, 7.0)]}
+        assert rule("event_cursor_lag").check(
+            ctx(snapshot=snap, history=preexisting)
+        ) == []
+
+    def test_ef_mass_growth_needs_monotonic_growth(self):
+        now = time.time()
+        cfg = {"ef_growth_evals": 3}
+        mono = {"v6t_compress_ef_norm": [
+            (now - i, v) for i, v in zip(range(4, -1, -1), [1, 2, 3, 4, 5])
+        ]}
+        wobbling = {"v6t_compress_ef_norm": [
+            (now - i, v) for i, v in zip(range(4, -1, -1), [1, 2, 3, 2, 4])
+        ]}
+        assert rule("ef_mass_growth").check(ctx(history=mono, config=cfg))
+        assert rule("ef_mass_growth").check(
+            ctx(history=wobbling, config=cfg)
+        ) == []
+
+    def test_rule_audit_contract(self):
+        """The exact invariants tools/check_collect.py gates on."""
+        declared = {n for n, _k, _h in KNOWN_METRICS}
+        names = [r.name for r in DEFAULT_RULES]
+        assert len(names) == len(set(names))
+        for r in DEFAULT_RULES:
+            r.validate()
+            assert r.severity in SEVERITIES
+            assert set(r.metrics) <= declared, r.name
+            assert r.name in RULE_CATALOG
+            assert RULE_CATALOG[r.name]["runbook"]
+
+    def test_rule_validate_rejects_bad_shapes(self):
+        good = dict(severity="warning", summary="s", runbook="r",
+                    metrics=(), check=lambda c: [])
+        with pytest.raises(ValueError):
+            AlertRule(name="CamelCase", **good).validate()
+        with pytest.raises(ValueError):
+            AlertRule(name="ok_name", **{**good, "severity": "bad"}).validate()
+        with pytest.raises(ValueError):
+            AlertRule(name="ok_name", **{**good, "runbook": ""}).validate()
+
+
+# ------------------------------------------------------------------ engine
+class TestEngine:
+    def test_raise_dedup_clear_cycle(self, wd, tracer):
+        state = {"runs": [{"run_id": 1, "task_id": 1, "status": "active",
+                           "started_at": time.time() - 100}]}
+        wd.configure(run_deadline_s=5.0)
+        wd.register_feed("t", lambda: state)
+        active = wd.evaluate()
+        assert [a["rule"] for a in active] == ["stuck_run"]
+        # second eval: same alert, deduplicated, count grows
+        active = wd.evaluate()
+        assert len(active) == 1 and active[0]["count"] == 2
+        # fault healed: cleared into recent
+        state["runs"] = []
+        assert wd.evaluate() == []
+        recent = wd.recent_alerts()
+        assert recent[0]["rule"] == "stuck_run"
+        assert recent[0]["resolved_at"] is not None
+
+    def test_alert_span_lands_on_task_trace(self, wd, tracer):
+        with tracer.span("client.task_create") as sp:
+            tp = sp.context.to_traceparent()
+            trace_id = sp.context.trace_id
+        wd.configure(run_deadline_s=5.0)
+        wd.register_feed("t", lambda: {"runs": [{
+            "run_id": 9, "task_id": 2, "status": "active",
+            "started_at": time.time() - 100, "traceparent": tp,
+        }]})
+        wd.evaluate()
+        spans = tracer.drain(trace_id)
+        names = {s["name"] for s in spans}
+        assert "alert.stuck_run" in names
+        alert_span = next(s for s in spans if s["name"] == "alert.stuck_run")
+        assert alert_span["attrs"]["label_run_id"] == 9
+        assert alert_span["events"][0]["name"] == "alert_raised"
+
+    def test_telemetry_counters_and_gauges(self, wd):
+        before = REGISTRY.snapshot()
+        state = {"nodes": [{"node_id": 5, "name": "n", "status": "online",
+                            "last_seen_at": time.time() - 999}]}
+        wd.configure(ping_window_s=1.0)
+        wd.register_feed("t", lambda: state)
+        wd.evaluate()
+        state["nodes"] = []
+        wd.evaluate()
+        after = REGISTRY.snapshot()
+        assert after["v6t_alerts_raised_total"] >= before.get(
+            "v6t_alerts_raised_total", 0) + 1
+        assert after["v6t_alerts_cleared_total"] >= before.get(
+            "v6t_alerts_cleared_total", 0) + 1
+        assert after["v6t_watchdog_evaluations_total"] >= before.get(
+            "v6t_watchdog_evaluations_total", 0) + 2
+        assert after["v6t_alerts_active"] == 0
+
+    def test_feed_failure_is_failsoft_and_counted(self, wd):
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise RuntimeError("db gone")
+
+        wd.register_feed("bad", bad)
+        before = REGISTRY.snapshot().get("v6t_watchdog_feed_errors_total", 0)
+        assert wd.evaluate() == []
+        assert wd.evaluate() == []
+        assert calls["n"] == 2
+        assert REGISTRY.snapshot()["v6t_watchdog_feed_errors_total"] >= before + 2
+
+    def test_feed_failure_holds_active_alerts(self, wd):
+        """A transiently failing feed is loss of evidence, not recovery:
+        active alerts hold (same raised_at, no clear transition) until a
+        clean evaluation stops proposing them."""
+        state = {"runs": [{"run_id": 3, "task_id": 3, "status": "active",
+                           "started_at": time.time() - 100}],
+                 "fail": False}
+
+        def feed():
+            if state["fail"]:
+                raise RuntimeError("database is locked")
+            return {"runs": state["runs"]}
+
+        wd.configure(run_deadline_s=5.0)
+        wd.register_feed("t", feed)
+        first = wd.evaluate()
+        assert [a["rule"] for a in first] == ["stuck_run"]
+        raised_at = first[0]["raised_at"]
+        state["fail"] = True
+        held = wd.evaluate()
+        assert [a["rule"] for a in held] == ["stuck_run"]
+        assert held[0]["raised_at"] == raised_at
+        assert wd.recent_alerts() == []  # no flap through resolved
+        # feed recovers, fault still present: the SAME alert continues
+        state["fail"] = False
+        again = wd.evaluate()
+        assert again[0]["raised_at"] == raised_at and again[0]["count"] == 2
+        # clean evaluation without the fault finally clears it
+        state["runs"] = []
+        assert wd.evaluate() == []
+        assert wd.recent_alerts()[0]["resolved_at"] is not None
+
+    def test_crashed_rule_holds_its_alerts(self, wd):
+        """A rule that crashes mid-evaluation must not clear the alerts it
+        raised earlier — only a successful pass that stops proposing them
+        may."""
+        state = {"mode": "fire"}
+
+        def check(ctx):
+            if state["mode"] == "crash":
+                raise RuntimeError("boom")
+            if state["mode"] == "fire":
+                return [{"message": "m", "labels": {"k": 1}}]
+            return []
+
+        wd.add_rule(AlertRule(
+            name="crashy_rule", severity="warning", summary="s",
+            runbook="r", metrics=(), check=check,
+        ))
+        assert [a["rule"] for a in wd.evaluate()] == ["crashy_rule"]
+        state["mode"] = "crash"
+        assert [a["rule"] for a in wd.evaluate()] == ["crashy_rule"]
+        assert wd.recent_alerts() == []
+        state["mode"] = "quiet"
+        assert wd.evaluate() == []
+        assert wd.recent_alerts()[0]["rule"] == "crashy_rule"
+
+    def test_unregister_feed_conditional(self, wd):
+        f1, f2 = (lambda: None), (lambda: None)
+        wd.register_feed("k", f1)
+        wd.register_feed("k", f2)  # replacement
+        wd.unregister_feed("k", f1)  # stale unregister: must not evict f2
+        assert wd._feeds.get("k") is f2
+        wd.unregister_feed("k", f2)
+        assert "k" not in wd._feeds
+
+    def test_duplicate_rule_rejected(self, wd):
+        with pytest.raises(ValueError, match="duplicate"):
+            wd.add_rule(default_rules()[0])
+
+    def test_configure_rejects_unknown_key(self, wd):
+        with pytest.raises(ValueError, match="unknown watchdog config"):
+            wd.configure(not_a_knob=1)
+
+
+# ------------------------------------------------------------------ health
+class TestHealth:
+    def test_components_fold_into_verdict(self, wd):
+        assert wd.health()["status"] == "ok"
+        wd.register_component("db", lambda: (False, "disk full"))
+        h = wd.health()
+        assert h["status"] == "degraded"
+        assert h["components"]["db"] == {"ok": False, "detail": "disk full"}
+        wd.register_component("db", lambda: True)  # bare-bool contract
+        assert wd.health()["status"] == "ok"
+
+    def test_raising_component_counts_as_failed(self, wd):
+        wd.register_component("boom", lambda: 1 / 0)
+        h = wd.health()
+        assert h["status"] == "degraded"
+        assert "self-check raised" in h["components"]["boom"]["detail"]
+
+    def test_critical_alert_degrades(self, wd):
+        wd.configure(run_deadline_s=1.0)
+        wd.register_feed("t", lambda: {"runs": [{
+            "run_id": 1, "task_id": 1, "status": "active",
+            "started_at": time.time() - 100}]})
+        wd.evaluate()
+        h = wd.health()
+        assert h["status"] == "degraded"
+        assert h["alerts"] == {"active": 1, "critical": 1}
+
+    def test_warning_alert_does_not_degrade(self, wd):
+        wd.register_feed("t", lambda: {"nodes": []})
+        wd.add_rule(AlertRule(
+            name="test_warn", severity="warning", summary="s", runbook="r",
+            metrics=(), check=lambda c: [{"message": "m", "labels": {}}],
+        ))
+        wd.evaluate()
+        assert wd.health()["status"] == "ok"
+
+    def test_self_check_states(self, wd):
+        ok, detail = wd.self_check()
+        assert ok and "on-demand" in detail
+        wd.start(interval=0.05)
+        try:
+            deadline = time.time() + 5
+            while wd.last_eval_at is None and time.time() < deadline:
+                time.sleep(0.01)
+            ok, _ = wd.self_check()
+            assert ok
+        finally:
+            wd.stop()
+        # stopped again: back to on-demand ok
+        assert wd.self_check()[0]
+
+
+# ---------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_rings_are_bounded(self):
+        fr = FlightRecorder(capacity=64)
+        for i in range(200):
+            fr.note("spam", i=i)
+        assert fr.stats()["notes"] == 64
+
+    def test_dump_and_read_bundle(self, tmp_path, tracer):
+        fr = FlightRecorder(capacity=64)
+        fr.record_log({"ts": time.time(), "level": "INFO", "msg": "x",
+                       "trace_id": "", "span_id": "", "logger": "t",
+                       "thread": 1})
+        fr.note("rest_error", status=500)
+        fr.snapshot_metrics()
+        path = fr.dump(path=str(tmp_path / "b.jsonl"), reason="test",
+                       detail="why")
+        recs = read_bundle(path)
+        types = {r["type"] for r in recs}
+        assert {"flight_header", "log", "note", "metrics"} <= types
+        header = recs[0]
+        assert header["reason"] == "test" and header["detail"] == "why"
+        assert fr.stats()["dumps_written"] == 1
+
+    def test_read_bundle_skips_torn_tail(self, tmp_path):
+        p = tmp_path / "torn.jsonl"
+        p.write_text(
+            json.dumps({"type": "note", "ts": 1.0, "kind": "k"}) + "\n"
+            + '{"type": "note", "ts": 2.0, "kin'  # torn mid-write
+        )
+        recs = read_bundle(str(p))
+        assert len(recs) == 1
+
+    def test_log_tap_carries_trace_ids(self, tracer):
+        log = setup_logging("vantage6_tpu/test_flight_tap")
+        FLIGHT.clear()
+        with tracer.span("op") as sp:
+            log.info("inside")
+            trace_id = sp.context.trace_id
+        logs = list(FLIGHT._logs)
+        mine = [r for r in logs if r["msg"] == "inside"]
+        assert mine and mine[-1]["trace_id"] == trace_id
+        # the span itself was tapped too
+        assert any(
+            s["trace_id"] == trace_id for s in FLIGHT._spans
+        )
+
+    def test_json_sink_runtime_toggle(self, tmp_path, tracer):
+        log = setup_logging("vantage6_tpu/test_json_sink")
+        path = tmp_path / "log.jsonl"
+        enable_json_sink(str(path))
+        try:
+            with tracer.span("jop") as sp:
+                log.warning("structured %s", "hello")
+                trace_id = sp.context.trace_id
+        finally:
+            disable_json_sink()
+        recs = read_jsonl(path)
+        mine = [r for r in recs if r["msg"] == "structured hello"]
+        assert mine and mine[0]["trace_id"] == trace_id
+        assert mine[0]["level"] == "WARNING"
+        # disabled: no further writes
+        log.warning("after close")
+        assert not any(
+            r["msg"] == "after close" for r in read_jsonl(path)
+        )
+
+    def test_disable_is_sticky_against_env_resurrection(
+        self, tmp_path, monkeypatch
+    ):
+        """disable_json_sink() must hold even when V6T_LOG_JSON is set: a
+        later FIRST-time setup_logging (lazily-imported module) would
+        otherwise silently re-arm the env sink the caller switched off."""
+        from vantage6_tpu.common import log as logmod
+
+        path = tmp_path / "env.jsonl"
+        monkeypatch.setenv("V6T_LOG_JSON", str(path))
+        disable_json_sink()
+        setup_logging("vantage6_tpu/sticky-probe")
+        assert logmod._JSON_HANDLER is None
+        # an explicit re-enable clears the stickiness
+        enable_json_sink(str(path))
+        assert logmod._JSON_HANDLER is not None
+        disable_json_sink()
+
+    def test_install_is_idempotent_and_first_label_wins(self):
+        from vantage6_tpu.common import flight
+
+        fr1 = flight.install(service="test-svc")
+        named = FLIGHT.service  # "test-svc" only if WE were first to name
+        fr2 = flight.install()
+        assert fr1 is fr2 is FLIGHT
+        # first-writer-wins: a later embedder (e.g. a daemon starting in a
+        # server process) must not re-label the process-global recorder
+        flight.install(service="late-relabel")
+        assert FLIGHT.service == named
+
+    def test_usr2_arming_retries_on_main_thread_install(self):
+        """A background-thread first installer can't arm SIGUSR2 (only the
+        main thread may set signal handlers); a later main-thread install
+        must retry instead of finding the process marked installed and
+        leaving the probe dead forever."""
+        import signal
+
+        from vantage6_tpu.common import flight
+
+        prev_handler = signal.getsignal(signal.SIGUSR2)
+        prev_armed = flight._usr2_armed
+        try:
+            signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+            flight._usr2_armed = False
+            t = threading.Thread(target=flight.install)
+            t.start(); t.join()
+            assert not flight._usr2_armed
+            assert signal.getsignal(signal.SIGUSR2) is signal.SIG_DFL
+            flight.install()  # main thread: the retry arms the probe
+            assert flight._usr2_armed
+            assert signal.getsignal(signal.SIGUSR2) is not signal.SIG_DFL
+        finally:
+            flight._usr2_armed = prev_armed
+            signal.signal(signal.SIGUSR2, prev_handler)
+
+
+# ----------------------------------------------- torn tails, live (satellite)
+class TestTornTailUnderConcurrentWriter:
+    def _hammer(self, path, make_line, reader, n_lines=300):
+        """Writer thread appends (with flushes mid-line); reader polls
+        concurrently — every successful read must parse cleanly."""
+        stop = threading.Event()
+        errors = []
+
+        def write():
+            with open(path, "w", buffering=1) as fh:
+                for i in range(n_lines):
+                    line = make_line(i)
+                    # tear every 7th line across two unflushed writes
+                    cut = len(line) // 2
+                    fh.write(line[:cut])
+                    fh.flush()
+                    fh.write(line[cut:] + "\n")
+            stop.set()
+
+        def read():
+            while not stop.is_set():
+                try:
+                    for rec in reader(path):
+                        assert isinstance(rec, dict)
+                except FileNotFoundError:
+                    pass
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+
+        w = threading.Thread(target=write)
+        r = threading.Thread(target=read)
+        w.start(); r.start()
+        w.join(timeout=30); r.join(timeout=30)
+        assert not errors
+        final = reader(path)
+        assert len(final) == n_lines
+
+    def test_read_spans_concurrent(self, tmp_path):
+        self._hammer(
+            str(tmp_path / "spans.jsonl"),
+            lambda i: json.dumps(
+                {"trace_id": f"t{i:04d}", "span_id": "s", "name": "n",
+                 "ts": float(i), "dur": 0.0}
+            ),
+            read_spans,
+        )
+
+    def test_read_jsonl_concurrent(self, tmp_path):
+        self._hammer(
+            str(tmp_path / "metrics.jsonl"),
+            lambda i: json.dumps({"event": "round", "round": i}),
+            read_jsonl,
+        )
+
+
+# -------------------------------------------------------------- server API
+@pytest.fixture()
+def srv():
+    TRACER.configure(enabled=True, sample=1.0, sink=None)
+    TRACER.clear()
+    app = ServerApp()
+    app.ensure_root(password="rootpass123")
+    yield app
+    app.close()
+    # restore singleton thresholds touched by tests
+    WATCHDOG.configure(
+        interval=5.0, run_deadline_s=300.0, ping_window_s=60.0,
+    )
+
+
+def _login(srv):
+    c = srv.test_client()
+    c.token = c.post(
+        "/api/token/user",
+        json_body={"username": "root", "password": "rootpass123"},
+    ).json["access_token"]
+    return c
+
+
+class TestServerApi:
+    def test_health_ok_with_components(self, srv):
+        h = srv.test_client().get("/api/health").json
+        assert h["status"] == "ok"
+        assert set(h["components"]) >= {"event_hub", "tracer_sink",
+                                        "watchdog"}
+        assert all(c["ok"] for c in h["components"].values())
+        assert h["alerts"]["active"] == 0
+        # the capability card survives the upgrade
+        assert h["long_poll"] is True and h["metrics"] == "/api/metrics"
+
+    def test_health_degraded_on_component_failure(self, srv):
+        WATCHDOG.register_component("injected", lambda: (False, "broken"))
+        try:
+            h = srv.test_client().get("/api/health").json
+            assert h["status"] == "degraded"
+            assert h["components"]["injected"]["detail"] == "broken"
+        finally:
+            WATCHDOG.unregister_component("injected")
+        assert srv.test_client().get("/api/health").json["status"] == "ok"
+
+    def test_alerts_endpoint_shape(self, srv):
+        a = srv.test_client().get("/api/alerts").json
+        assert a["active"] == [] and a["status"] == "ok"
+        assert set(a["rules"]) == {r.name for r in DEFAULT_RULES}
+        assert all(
+            row["summary"] and row["runbook"]
+            for row in a["rules"].values()
+        )
+
+    def test_debug_dump_requires_auth(self, srv):
+        c = srv.test_client()
+        assert c.post("/api/debug/dump").status == 401
+        r = _login(srv).post("/api/debug/dump")
+        assert r.status == 201
+        assert read_bundle(r.json["path"])[0]["type"] == "flight_header"
+
+    def test_double_close_keeps_newer_embedders_watchdog(self):
+        """close() is idempotent: a second close of an old ServerApp must
+        not decrement the refcounted singleton again and stop a NEWER
+        embedder's evaluation thread."""
+        a = ServerApp()
+        a.close()
+        b = ServerApp()
+        try:
+            with WATCHDOG._lock:
+                users = WATCHDOG._users
+            assert users >= 1 and WATCHDOG._thread is not None
+            a.close()  # stale re-close: must be a no-op
+            with WATCHDOG._lock:
+                assert WATCHDOG._users == users
+            assert (
+                WATCHDOG._thread is not None and WATCHDOG._thread.is_alive()
+            )
+        finally:
+            b.close()
+
+    def test_wedged_run_and_lapsed_node_degrade(self, srv):
+        """The acceptance smoke, deterministic: a run wedged ACTIVE past
+        its deadline + a node online past its ping window raise their
+        alerts on the next evaluation, flip /api/health to degraded, and
+        a dump doctors into a timeline naming the stuck run."""
+        from vantage6_tpu.server import models as m
+
+        c = _login(srv)
+        org = c.post("/api/organization", json_body={"name": "o"}).json
+        collab = c.post("/api/collaboration", json_body={
+            "name": "c", "organization_ids": [org["id"]],
+        }).json
+        node = c.post("/api/node", json_body={
+            "organization_id": org["id"],
+            "collaboration_id": collab["id"],
+        }).json
+        with TRACER.span("client.task_create"):
+            task = c.post("/api/task", json_body={
+                "collaboration_id": collab["id"],
+                "organizations": [{"id": org["id"]}],
+                "image": "img",
+                "input": {"method": "m"},
+            }).json
+        run_id = task["runs"][0]
+        run = m.TaskRun.get(run_id)
+        run.status = "active"
+        run.started_at = time.time() - 100
+        run.save()
+        dbnode = m.Node.get(node["id"])
+        dbnode.status = "online"
+        dbnode.last_seen_at = time.time() - 100
+        dbnode.save()
+        WATCHDOG.configure(run_deadline_s=5.0, ping_window_s=5.0)
+        active = WATCHDOG.evaluate()
+        rules = {a["rule"] for a in active}
+        assert {"stuck_run", "daemon_lapsed"} <= rules
+        stuck = next(a for a in active if a["rule"] == "stuck_run")
+        assert stuck["labels"]["run_id"] == run_id
+        # the alert is parented on the task's own trace
+        assert parse_traceparent(stuck["traceparent"]).trace_id \
+            == task["trace_id"]
+        assert c.get("/api/health").json["status"] == "degraded"
+        api = c.get("/api/alerts").json
+        assert {a["rule"] for a in api["active"]} >= {"stuck_run",
+                                                      "daemon_lapsed"}
+        # post-mortem: dump + doctor name the stuck run
+        dump = c.post("/api/debug/dump").json
+        import tools.doctor as doctor
+
+        rc = doctor.main([dump["path"], "--trace",
+                          task["trace_id"][:8], "--tail", "0"])
+        assert rc == 0
+        rows = doctor.timeline(
+            doctor.load([dump["path"]]), trace=task["trace_id"][:8]
+        )
+        assert any(
+            r.get("name") == "alert.stuck_run" for r in rows
+        )
+        digest = doctor.alert_digest(doctor.load([dump["path"]]))
+        stuck_row = next(d for d in digest if d["rule"] == "stuck_run")
+        assert f"run {run_id}" in stuck_row["message"]
+        assert stuck_row["runbook"]
+        # healed: watchdog clears, health recovers
+        run2 = m.TaskRun.get(run_id)
+        run2.status = "completed"
+        run2.save()
+        dbnode2 = m.Node.get(node["id"])
+        dbnode2.last_seen_at = time.time()
+        dbnode2.save()
+        WATCHDOG.evaluate()
+        assert c.get("/api/health").json["status"] == "ok"
+
+    def test_tracer_sink_failure_degrades_health(self, srv, tmp_path):
+        """The tracer-sink component self-check: a span sink that died
+        mid-flight (disk full / unwritable path) must flip /api/health
+        to degraded — trace evidence is being lost."""
+        c = srv.test_client()
+        assert c.get("/api/health").json["status"] == "ok"
+        TRACER.configure(sink=str(tmp_path / "no-such-dir" / "x.jsonl"))
+        try:
+            with TRACER.span("doomed"):
+                pass  # the write fails, sink_errors increments
+            h = c.get("/api/health").json
+            assert h["status"] == "degraded"
+            assert not h["components"]["tracer_sink"]["ok"]
+            assert "sink" in h["components"]["tracer_sink"]["detail"]
+        finally:
+            # the public heal path: re-pointing/clearing the sink resets
+            # the failure streak — no hand-poking of sink_errors needed
+            TRACER.configure(sink=None)
+        assert TRACER.sink_errors == 0
+        assert c.get("/api/health").json["status"] == "ok"
+
+    def test_metrics_exposes_watchdog_series(self, srv):
+        WATCHDOG.evaluate()
+        text = srv.test_client().get("/api/metrics").body.decode()
+        for series in (
+            "v6t_alerts_active", "v6t_watchdog_evaluations_total",
+            "v6t_health_degraded", "v6t_flight_records",
+        ):
+            assert series in text
+
+
+# -------------------------------------------------- daemon backoff satellite
+class TestDaemonBackoff:
+    def test_one_warning_per_streak_and_counter(self, srv):
+        from vantage6_tpu.node.daemon import NodeDaemon
+
+        http = srv.serve(port=0, background=True)
+        c = _login(srv)
+        org = c.post("/api/organization", json_body={"name": "bo"}).json
+        collab = c.post("/api/collaboration", json_body={
+            "name": "bc", "organization_ids": [org["id"]],
+        }).json
+        node = c.post("/api/node", json_body={
+            "organization_id": org["id"],
+            "collaboration_id": collab["id"],
+        }).json
+        d = NodeDaemon(
+            api_url=http.url, api_key=node["api_key"],
+            mode="inline", poll_interval=0.01, event_wait=0.0,
+        )
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        daemon_log = logging.getLogger("vantage6_tpu/node")
+        old_level = daemon_log.level
+        daemon_log.addHandler(handler)
+        daemon_log.setLevel(logging.DEBUG)
+        before = REGISTRY.snapshot().get("v6t_daemon_backoff_total", 0)
+        try:
+            http.stop()  # the server goes away mid-life
+            for _ in range(3):
+                assert d._poll_once() is True  # backoff slept for us
+        finally:
+            daemon_log.removeHandler(handler)
+            daemon_log.setLevel(old_level)
+        poll_records = [
+            r for r in records if "event poll failed" in r.getMessage()
+        ]
+        warnings = [r for r in poll_records
+                    if r.levelno == logging.WARNING]
+        debugs = [r for r in poll_records if r.levelno == logging.DEBUG]
+        assert len(warnings) == 1  # once per streak
+        assert len(debugs) == 2   # the rest demoted
+        assert REGISTRY.snapshot()["v6t_daemon_backoff_total"] == before + 3
+        # every attempt still lands in the flight recorder
+        notes = [n for n in list(FLIGHT._notes)
+                 if n["kind"] == "event_poll_error"]
+        assert len(notes) >= 3
+
+    def test_ping_bookkeeping(self, srv):
+        from vantage6_tpu.node.daemon import NodeDaemon
+        from vantage6_tpu.server import models as m
+
+        http = srv.serve(port=0, background=True)
+        c = _login(srv)
+        org = c.post("/api/organization", json_body={"name": "po"}).json
+        collab = c.post("/api/collaboration", json_body={
+            "name": "pc", "organization_ids": [org["id"]],
+        }).json
+        node = c.post("/api/node", json_body={
+            "organization_id": org["id"],
+            "collaboration_id": collab["id"],
+        }).json
+        try:
+            d = NodeDaemon(
+                api_url=http.url, api_key=node["api_key"], mode="inline",
+                sync_interval=30.0, ping_interval=0.5,
+            )
+            assert d.ping_interval == 0.5
+            before = m.Node.get(node["id"]).last_seen_at
+            d.ping()
+            assert d.last_ping_at is not None
+            assert d.ping_failures == 0
+            after = m.Node.get(node["id"]).last_seen_at
+            assert after is not None and (before is None or after >= before)
+        finally:
+            http.stop()
+
+
+# ------------------------------------------------------------------- doctor
+class TestDoctor:
+    def _bundle(self, tmp_path):
+        recs = [
+            {"type": "flight_header", "ts": 10.0, "service": "s", "pid": 1,
+             "reason": "test", "detail": "", "counts": {}},
+            {"type": "log", "ts": 12.0, "level": "INFO", "logger": "l",
+             "msg": "later", "trace_id": "aa" * 16, "span_id": "", "thread": 1},
+            {"type": "span", "ts": 11.0, "dur": 0.5, "name": "exec",
+             "trace_id": "aa" * 16, "span_id": "bb" * 8, "kind": "exec",
+             "service": "d", "status": "ok", "attrs": {}},
+            {"type": "log", "ts": 11.5, "level": "INFO", "logger": "l",
+             "msg": "ambient", "trace_id": "", "span_id": "", "thread": 1},
+            {"type": "log", "ts": 99999.0, "level": "INFO", "logger": "l",
+             "msg": "far away untraced", "trace_id": "", "span_id": "",
+             "thread": 1},
+            {"type": "alert", "rule": "stuck_run", "severity": "critical",
+             "message": "run 42 of task 7 ACTIVE", "labels": {"run_id": 42},
+             "traceparent": f"00-{'aa' * 16}-{'bb' * 8}-01",
+             "raised_at": 11.8, "last_seen_at": 11.8, "count": 1,
+             "resolved_at": None},
+        ]
+        p = tmp_path / "bundle.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        return str(p)
+
+    def test_digest_explains_against_catalog(self, tmp_path):
+        import tools.doctor as doctor
+
+        digest = doctor.alert_digest(doctor.load([self._bundle(tmp_path)]))
+        assert len(digest) == 1
+        row = digest[0]
+        assert row["rule"] == "stuck_run"
+        assert row["summary"] == RULE_CATALOG["stuck_run"]["summary"]
+        assert row["trace_id"] == "aa" * 16
+
+    def test_digest_dedups_on_labels_not_message(self, tmp_path):
+        """One alert re-observed with a grown age in its message is ONE
+        digest entry (key = rule+labels, the watchdog's own identity); a
+        different run of the same rule is a second entry."""
+        import tools.doctor as doctor
+
+        recs = [
+            {"type": "note", "ts": 11.8, "kind": "alert_raised",
+             "rule": "stuck_run", "severity": "critical",
+             "message": "run 42 ACTIVE for 1.2s", "labels": {"run_id": 42}},
+            {"type": "alert", "rule": "stuck_run", "severity": "critical",
+             "message": "run 42 ACTIVE for 9.8s", "labels": {"run_id": 42},
+             "raised_at": 11.8, "last_seen_at": 19.8, "count": 5,
+             "resolved_at": None},
+            {"type": "span", "ts": 11.8, "dur": 0.0,
+             "name": "alert.stuck_run", "trace_id": "aa" * 16,
+             "span_id": "cc" * 8, "kind": "alert", "service": "s",
+             "status": "ok",
+             "attrs": {"message": "run 42 ACTIVE for 1.2s",
+                       "label_run_id": 42}},
+            {"type": "alert", "rule": "stuck_run", "severity": "critical",
+             "message": "run 7 ACTIVE for 3.0s", "labels": {"run_id": 7},
+             "raised_at": 12.0, "last_seen_at": 12.0, "count": 1,
+             "resolved_at": None},
+        ]
+        p = tmp_path / "dedup.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        digest = doctor.alert_digest(doctor.load([str(p)]))
+        assert len(digest) == 2
+        assert {str(d["labels"].get("run_id")) for d in digest} == {"42", "7"}
+
+    def test_timeline_merges_and_orders(self, tmp_path):
+        import tools.doctor as doctor
+
+        rows = doctor.timeline(doctor.load([self._bundle(tmp_path)]))
+        ts = [r["ts"] for r in rows]
+        assert ts == sorted(ts)
+        assert {r["type"] for r in rows} == {"log", "span"}
+
+    def test_trace_filter_keeps_ambient_window(self, tmp_path):
+        import tools.doctor as doctor
+
+        rows = doctor.timeline(
+            doctor.load([self._bundle(tmp_path)]), trace="aa" * 4,
+            window=5.0,
+        )
+        msgs = {r.get("msg") or r.get("name") for r in rows}
+        assert "exec" in msgs and "later" in msgs
+        assert "ambient" in msgs            # untraced but inside window
+        assert "far away untraced" not in msgs
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        import tools.doctor as doctor
+
+        assert doctor.main([self._bundle(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "run 42" in out and "stuck_run" in out
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert doctor.main([str(empty)]) == 1
+
+
+# -------------------------------------------------------------- bench trend
+class TestBenchTrend:
+    def _write_round(self, tmp_path, n, parsed=None, tail="", invalid=False):
+        doc = {"n": n, "cmd": "bench", "rc": 0, "tail": tail,
+               "parsed": parsed}
+        if invalid:
+            doc["invalid"] = "bad round"
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(doc))
+
+    def test_trend_and_regression_exit(self, tmp_path, capsys):
+        import tools.bench_trend as bt
+
+        self._write_round(tmp_path, 1, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 1.0})
+        self._write_round(tmp_path, 2, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 0.5})
+        assert bt.main(["--root", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSIONS" in out and "baseline_rounds_per_sec" in out
+
+    def test_no_regression_within_threshold(self, tmp_path):
+        import tools.bench_trend as bt
+
+        self._write_round(tmp_path, 1, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 1.0})
+        self._write_round(tmp_path, 2, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 0.9})
+        assert bt.main(["--root", str(tmp_path)]) == 0
+
+    def test_platform_split_prevents_false_regression(self, tmp_path):
+        import tools.bench_trend as bt
+
+        self._write_round(tmp_path, 1, parsed={
+            "platform": "tpu", "baseline_rounds_per_sec": 100.0})
+        self._write_round(tmp_path, 2, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 1.0})
+        assert bt.main(["--root", str(tmp_path)]) == 0
+
+    def test_invalid_round_excluded_from_baseline(self, tmp_path):
+        import tools.bench_trend as bt
+
+        self._write_round(tmp_path, 1, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 100.0},
+            invalid=True)
+        self._write_round(tmp_path, 2, parsed={
+            "platform": "cpu", "baseline_rounds_per_sec": 1.0})
+        assert bt.main(["--root", str(tmp_path)]) == 0
+
+    def test_tail_regex_fallback(self, tmp_path):
+        import tools.bench_trend as bt
+
+        self._write_round(
+            tmp_path, 1,
+            tail='garbage head ... "baseline_rounds_per_sec": 2.5, '
+                 '"platform": "cpu"}',
+        )
+        rounds = bt.collect(str(tmp_path))
+        assert rounds[0]["values"]["baseline_rounds_per_sec"] == 2.5
+        assert rounds[0]["platform"] == "cpu"
+
+    def test_no_rounds_exit_2(self, tmp_path):
+        import tools.bench_trend as bt
+
+        assert bt.main(["--root", str(tmp_path)]) == 2

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Bench trajectory: per-leg headline numbers across committed rounds.
+
+The repo commits one `BENCH_rNN.json` per growth round (the driver's
+capture of `python bench.py`: `{n, cmd, rc, tail, parsed}` — `parsed` is
+the final JSON object when the driver recovered it, `tail` the raw stdout
+tail otherwise). Nothing ever read them TOGETHER, so a leg could decay
+20% per round and no gate would notice. This tool is that gate:
+
+- **Trend table** — for every headline metric (one per bench leg), its
+  value in every round, annotated with the round's platform (TPU rounds
+  and degraded-CPU rounds are different machines — they are never
+  compared against each other).
+- **Regression check** — the latest usable round is compared per-metric
+  against the BEST prior usable round on the same platform; worse than
+  `--threshold` (default 20%) in the metric's direction exits nonzero
+  with one line per regression. Rounds marked `invalid` (a round whose
+  VERDICT rejected its own numbers) are shown but never used as baseline
+  or subject.
+
+`tools/check_collect.py` runs this as an ADVISORY note (prints, never
+fails CI): bench numbers wobble with host load, so perf drift should be
+loudly visible on every run while the hard gate stays the bench's own
+per-leg acceptance bars.
+
+Usage:
+    python tools/bench_trend.py [--root DIR] [--threshold PCT] [--json]
+
+Exit codes: 0 = no regression (or nothing comparable); 1 = regression(s);
+2 = no bench rounds found.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any
+
+# metric -> (direction, leg) — the one headline number per bench leg.
+# direction "higher" = bigger is better. Keys may live at the top level
+# of the bench JSON or one nesting deep; regex fallback finds them
+# anywhere in a truncated tail.
+HEADLINES: list[tuple[str, str, str]] = [
+    ("achieved_flops_per_sec", "higher", "spmd"),
+    ("baseline_rounds_per_sec", "higher", "baseline"),
+    ("transformer_tokens_per_sec", "higher", "transformer"),
+    ("speedup_pooled_vs_sequential", "higher", "host_parallel"),
+    ("speedup_tasks_per_sec", "higher", "control_plane"),
+    ("roundtrip_speedup_v2_vs_v1", "higher", "wire_format"),
+    ("tasks_per_sec_tracing_off", "higher", "observability"),
+    # NOTE: observability's overhead_pct is deliberately absent — it is a
+    # percentage that legitimately goes negative (host-load noise makes
+    # the ON arm faster), so best-prior comparison is meaningless; its
+    # hard gate is the bench leg's own overhead_ok bar, and the leg's
+    # throughput trend rides tasks_per_sec_tracing_off above.
+    ("wire_reduction_ratio", "higher", "compression"),
+]
+
+_NUM_RE = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+
+
+def _flatten(obj: Any, out: dict[str, float], depth: int = 0) -> None:
+    """Top-level keys win over nested duplicates (setdefault order)."""
+    if not isinstance(obj, dict) or depth > 2:
+        return
+    for k, v in obj.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.setdefault(str(k), float(v))
+    for v in obj.values():
+        _flatten(v, out, depth + 1)
+
+
+def extract_round(path: str) -> dict[str, Any] | None:
+    """One round's usable view: {round, platform, invalid, values{}}."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return None
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    rnd = int(m.group(1)) if m else -1
+    values: dict[str, float] = {}
+    platform = None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        _flatten(parsed, values)
+        platform = parsed.get("platform")
+    tail = doc.get("tail") or ""
+    if tail:
+        # regex fallback for rounds whose tail lost its JSON head: any
+        # headline key found anywhere in the text (first match wins, same
+        # as the flatten's top-level-first stance)
+        for name, _direction, _leg in HEADLINES:
+            if name in values:
+                continue
+            fm = re.search(rf'"{name}"\s*:\s*{_NUM_RE}', tail)
+            if fm:
+                values[name] = float(fm.group(1))
+        if platform is None:
+            pm = re.search(r'"platform"\s*:\s*"(\w+)"', tail)
+            platform = pm.group(1) if pm else None
+    if not values:
+        return None
+    return {
+        "round": rnd,
+        "file": os.path.basename(path),
+        "platform": platform or "unknown",
+        "invalid": bool(doc.get("invalid")),
+        "rc": doc.get("rc"),
+        "values": values,
+    }
+
+
+def collect(root: str) -> list[dict[str, Any]]:
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        row = extract_round(path)
+        if row is not None:
+            rounds.append(row)
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def regressions(
+    rounds: list[dict[str, Any]], threshold_pct: float
+) -> list[str]:
+    """Latest usable round vs the best prior usable round, per metric,
+    same platform only."""
+    usable = [r for r in rounds if not r["invalid"]]
+    if len(usable) < 2:
+        return []
+    latest = usable[-1]
+    prior = [r for r in usable[:-1] if r["platform"] == latest["platform"]]
+    if not prior:
+        return []
+    out = []
+    for name, direction, leg in HEADLINES:
+        cur = latest["values"].get(name)
+        if cur is None:
+            continue
+        hist = [
+            r["values"][name] for r in prior if name in r["values"]
+        ]
+        if not hist:
+            continue
+        best = max(hist) if direction == "higher" else min(hist)
+        if best <= 0:
+            # sign-crossing baselines (a negative overhead, a zeroed
+            # metric) make percent-change meaningless in both directions
+            continue
+        if direction == "higher":
+            drop = 100.0 * (best - cur) / best
+        else:
+            drop = 100.0 * (cur - best) / best
+        if drop > threshold_pct:
+            out.append(
+                f"{leg}/{name}: {cur:g} vs best prior {best:g} on "
+                f"{latest['platform']} ({drop:.1f}% worse, threshold "
+                f"{threshold_pct:g}%)"
+            )
+    return out
+
+
+def render_table(rounds: list[dict[str, Any]]) -> str:
+    cols = [f"r{r['round']:02d}" for r in rounds]
+    tags = [
+        ("!" if r["invalid"] else "") + r["platform"][:3] for r in rounds
+    ]
+    name_w = max(len(n) for n, _, _ in HEADLINES) + 2
+    lines = [
+        "bench trend (committed BENCH_r*.json; '!' = round marked invalid)",
+        "",
+        f"{'metric':<{name_w}}" + "".join(f"{c:>14}" for c in cols),
+        f"{'platform':<{name_w}}" + "".join(f"{t:>14}" for t in tags),
+        "-" * (name_w + 14 * len(cols)),
+    ]
+    for name, direction, leg in HEADLINES:
+        cells = []
+        any_val = False
+        for r in rounds:
+            v = r["values"].get(name)
+            if v is None:
+                cells.append(f"{'—':>14}")
+            else:
+                any_val = True
+                cells.append(f"{v:>14.4g}")
+        if any_val:
+            arrow = "↑" if direction == "higher" else "↓"
+            lines.append(f"{name + ' ' + arrow:<{name_w}}" + "".join(cells))
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    ap.add_argument("--threshold", type=float, default=20.0,
+                    help="regression threshold in percent (default 20)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    rounds = collect(args.root)
+    if not rounds:
+        print("no usable BENCH_r*.json rounds found", file=sys.stderr)
+        return 2
+    regs = regressions(rounds, args.threshold)
+    if args.json:
+        print(json.dumps(
+            {"rounds": rounds, "regressions": regs}, indent=2
+        ))
+    else:
+        print(render_table(rounds))
+        if regs:
+            print("\nREGRESSIONS (latest vs best prior, same platform):")
+            for r in regs:
+                print(f"  {r}")
+        else:
+            print("\nno regression vs best prior same-platform round")
+    return 1 if regs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -76,6 +76,14 @@ _ROUTE_AUDIT: dict[str, list[str]] = {
         "vantage6_tpu/client/client.py",
     ],
     "metrics": ["vantage6_tpu/client/client.py"],
+    # ops plane (watchdog PR): alerts is the client util surface AND the
+    # daemon's watchdog-client probe; debug/dump is the client util's
+    # crash-forensics trigger
+    "alerts": [
+        "vantage6_tpu/client/client.py",
+        "vantage6_tpu/node/daemon.py",
+    ],
+    "debug/dump": ["vantage6_tpu/client/client.py"],
 }
 
 
@@ -176,6 +184,82 @@ def check_telemetry_metrics() -> list[str]:
         if not help_:
             problems.append(f"metric {name!r} has no help string")
     return problems
+
+
+def check_alert_rules() -> list[str]:
+    """Audit the watchdog's declarative alert surface
+    (`runtime/watchdog.py` DEFAULT_RULES, docs/observability.md):
+
+    - every rule name unique and snake_case, with a summary + runbook
+      (the catalog `tools/doctor.py` explains alerts against);
+    - severity one of the declared levels;
+    - every telemetry series a rule reads declared in KNOWN_METRICS — a
+      rule referencing a renamed/undeclared metric would silently read
+      None forever and never fire. Undeclared-rule drift fails here,
+      before any test runs.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import KNOWN_METRICS
+        from vantage6_tpu.runtime.watchdog import (
+            DEFAULT_RULES,
+            RULE_CATALOG,
+        )
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import the watchdog rule table: {e!r}"]
+    declared = {name for name, _kind, _help in KNOWN_METRICS}
+    # NOTE: name uniqueness + rule.validate() (snake_case, severity,
+    # summary/runbook presence) are enforced by Watchdog.add_rule at
+    # import time — a violating table makes the import above fail loudly,
+    # so re-checking them here would be dead code. This gate audits only
+    # what import does NOT: the KNOWN_METRICS contract and the catalog.
+    for rule in DEFAULT_RULES:
+        for metric in rule.metrics:
+            if metric not in declared:
+                problems.append(
+                    f"alert rule {rule.name!r} reads metric {metric!r} "
+                    "not declared in KNOWN_METRICS (common/telemetry.py)"
+                )
+        if rule.name not in RULE_CATALOG:
+            problems.append(
+                f"alert rule {rule.name!r} missing from RULE_CATALOG "
+                "(doctor.py would render it unexplained)"
+            )
+    return problems
+
+
+def note_bench_trend() -> None:
+    """ADVISORY (never fails the gate): run tools/bench_trend.py and
+    surface perf drift across the committed BENCH_r*.json rounds. Bench
+    numbers wobble with host load — the hard bars live in the bench legs
+    themselves; this note makes a >20% trajectory slide impossible to
+    miss in CI logs."""
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO_ROOT, "tools", "bench_trend.py")],
+            capture_output=True, text=True, cwd=_REPO_ROOT, timeout=60,
+        )
+    except (subprocess.TimeoutExpired, OSError) as e:
+        # advisory means ADVISORY: a hung/unrunnable trend tool is a note,
+        # never a gate failure
+        sys.stderr.write(f"  note: bench_trend.py could not run: {e}\n")
+        return
+    if proc.returncode == 1:
+        sys.stderr.write(
+            "  note: bench trend regression (ADVISORY, not fatal — see "
+            "tools/bench_trend.py):\n"
+        )
+        for line in (proc.stdout or "").splitlines():
+            if line.strip():
+                sys.stderr.write(f"    {line}\n")
+    elif proc.returncode not in (0, 2):
+        sys.stderr.write(
+            f"  note: bench_trend.py crashed (rc={proc.returncode}); "
+            "trend visibility lost\n"
+        )
 
 
 def check_golden_blobs() -> list[str]:
@@ -300,6 +384,18 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    alert_problems = check_alert_rules()
+    if alert_problems:
+        sys.stderr.write(
+            "ALERT RULES BROKEN: the watchdog rule table fails the "
+            "naming/metric-declaration audit (docs/observability.md):\n"
+        )
+        for p in alert_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
+    note_bench_trend()
+
     lint_problems = check_static_analysis()
     if lint_problems:
         sys.stderr.write(
@@ -349,6 +445,8 @@ def main(argv: list[str]) -> int:
         print("route audit ok: batched control-plane + observability "
               "endpoints match their call sites")
         print("telemetry audit ok: metric names unique and snake_case")
+        print("alert-rule audit ok: watchdog rules named, cataloged, and "
+              "reading only declared metrics")
         print("static analysis ok: v6lint found no unwaived violations")
         print(f"collection clean: {counted} tests collected")
         return 0

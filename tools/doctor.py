@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Post-mortem doctor: merge a flight-recorder bundle into ONE timeline.
+
+Input: one or more flight-recorder JSONL bundles (`common.flight` dumps —
+written on fatal error, `kill -USR2`, or `POST /api/debug/dump`), plus
+optionally raw span JSONL files (`V6T_TRACE_FILE` sinks). Each process of
+a deployment dumps its own bundle; pass them all and the records merge by
+wall-clock and correlate by trace_id.
+
+Output, per bundle set:
+
+- the **alert digest** — every watchdog alert in the bundles, explained
+  against the rule catalog (`runtime.watchdog.RULE_CATALOG`): what the
+  rule means, what to do, and — when the alert carries the affected
+  task's traceparent — which trace to read;
+- the **merged timeline** — log records interleaved with spans and ops
+  notes in wall-clock order, each line tagged with its short trace id, so
+  "what happened around the failure" reads top to bottom without
+  re-running anything under V6T_TRACE.
+
+Usage:
+    python tools/doctor.py bundle.jsonl [more.jsonl ...]
+        [--trace TRACE_ID]   only records of this trace (prefix ok) +
+                             untraced records in its time window
+        [--window S]         untraced-record window around the trace
+                             (default 5 s)
+        [--tail N]           last N timeline lines (default 200, 0 = all)
+        [--json]             machine-readable digest instead of text
+
+Exit codes: 0 = rendered; 1 = no records found.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from vantage6_tpu.common.flight import read_bundle  # noqa: E402
+from vantage6_tpu.runtime.tracing import (  # noqa: E402
+    parse_traceparent,
+    read_spans,
+)
+from vantage6_tpu.runtime.watchdog import RULE_CATALOG  # noqa: E402
+
+
+def load(paths: list[str]) -> list[dict[str, Any]]:
+    """Every record of every input file, as flight-bundle-shaped dicts.
+    Raw span-sink files (no "type" field) are wrapped as span records."""
+    records: list[dict[str, Any]] = []
+    for path in paths:
+        try:
+            recs = read_bundle(path)
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            continue
+        if recs:
+            for r in recs:
+                r.setdefault("_file", os.path.basename(path))
+            records.extend(recs)
+            continue
+        # not a bundle (or empty): try it as a raw span JSONL sink
+        try:
+            for sp in read_spans(path):
+                records.append({
+                    "type": "span", "_file": os.path.basename(path), **sp
+                })
+        except OSError:
+            pass
+    return records
+
+
+def _trace_of(rec: dict[str, Any]) -> str:
+    tid = rec.get("trace_id") or ""
+    if not tid and rec.get("traceparent"):
+        ctx = parse_traceparent(rec["traceparent"])
+        tid = ctx.trace_id if ctx else ""
+    return tid
+
+
+def alert_digest(records: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Alert records + alert spans + alert_raised notes, deduplicated on
+    (rule, labels) — the watchdog's own identity, NOT the message, whose
+    embedded age grows between evaluations — each explained against the
+    rule catalog."""
+    seen: set[tuple[str, tuple]] = set()
+    out: list[dict[str, Any]] = []
+    for rec in records:
+        rule = message = None
+        labels: dict[str, Any] = {}
+        ts = rec.get("ts") or rec.get("raised_at")
+        if rec.get("type") == "alert":
+            rule, message = rec.get("rule"), rec.get("message")
+            labels = rec.get("labels") or {}
+        elif rec.get("type") == "note" and rec.get("kind") == "alert_raised":
+            rule, message = rec.get("rule"), rec.get("message")
+            labels = rec.get("labels") or {}
+        elif (
+            rec.get("type") == "span"
+            and str(rec.get("name", "")).startswith("alert.")
+        ):
+            rule = rec["name"][len("alert."):]
+            attrs = rec.get("attrs") or {}
+            message = attrs.get("message")
+            labels = {
+                k[len("label_"):]: v
+                for k, v in attrs.items() if k.startswith("label_")
+            }
+        if not rule:
+            continue
+        key = (
+            str(rule),
+            tuple(sorted((str(k), str(v)) for k, v in labels.items())),
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        catalog = RULE_CATALOG.get(str(rule), {})
+        out.append({
+            "rule": rule,
+            "severity": rec.get("severity") or catalog.get("severity", "?"),
+            "message": message,
+            "ts": ts,
+            "trace_id": _trace_of(rec),
+            "labels": labels,
+            "summary": catalog.get("summary", "(rule not in catalog)"),
+            "runbook": catalog.get("runbook", ""),
+        })
+    sev_rank = {"critical": 0, "warning": 1, "info": 2}
+    out.sort(key=lambda a: (sev_rank.get(str(a["severity"]), 3), a["rule"]))
+    return out
+
+
+def timeline(
+    records: list[dict[str, Any]],
+    trace: str | None = None,
+    window: float = 5.0,
+) -> list[dict[str, Any]]:
+    """Wall-clock-ordered merge of log/span/note records. With a trace
+    filter: that trace's records, plus untraced records (notes, logs
+    outside spans) within `window` seconds of the trace's span — the
+    ambient context a correlated-only view would hide."""
+    rows = [
+        r for r in records if r.get("type") in ("log", "span", "note")
+        and isinstance(r.get("ts"), (int, float))
+    ]
+    if trace:
+        matched = [r for r in rows if _trace_of(r).startswith(trace)]
+        if matched:
+            t0 = min(r["ts"] for r in matched) - window
+            t1 = max(
+                r["ts"] + (r.get("dur") or 0.0) for r in matched
+            ) + window
+            ambient = [
+                r for r in rows
+                if not _trace_of(r) and t0 <= r["ts"] <= t1
+            ]
+            rows = matched + ambient
+        else:
+            rows = matched
+    # dedupe: the same span/log lands in several processes' bundles (e.g.
+    # a bundle dumped twice) — key on the most identifying fields
+    seen: set[tuple] = set()
+    unique = []
+    for r in rows:
+        key = (
+            r.get("type"), r.get("ts"), r.get("span_id"), r.get("msg"),
+            r.get("name"), r.get("kind"),
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(r)
+    unique.sort(key=lambda r: r["ts"])
+    return unique
+
+
+def render_line(rec: dict[str, Any]) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(rec["ts"]))
+    ms = int((rec["ts"] % 1) * 1000)
+    stamp = f"{ts}.{ms:03d}"
+    tid = _trace_of(rec)
+    tcol = f"[{tid[:8]}]" if tid else "[--------]"
+    if rec["type"] == "log":
+        return (
+            f"{stamp} {tcol} log   {rec.get('level', '?'):<8} "
+            f"{rec.get('logger', '')}: {rec.get('msg', '')}"
+        )
+    if rec["type"] == "span":
+        dur_ms = (rec.get("dur") or 0.0) * 1e3
+        events = "".join(
+            f" +{e.get('name')}" for e in rec.get("events") or []
+        )
+        return (
+            f"{stamp} {tcol} span  {rec.get('name', '?'):<24} "
+            f"{dur_ms:>9.3f} ms  [{rec.get('service', '')}]"
+            f"{' !' + rec['status'] if rec.get('status') not in (None, 'ok') else ''}"
+            f"{events}"
+        )
+    fields = {
+        k: v for k, v in rec.items()
+        if k not in ("type", "ts", "kind", "_file")
+    }
+    return (
+        f"{stamp} {tcol} note  {rec.get('kind', '?'):<24} "
+        + json.dumps(fields, default=str)
+    )
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="flight bundle(s) / span sink(s)")
+    ap.add_argument("--trace", help="restrict to one trace_id (prefix ok)")
+    ap.add_argument(
+        "--window", type=float, default=5.0,
+        help="seconds of untraced context around a --trace (default 5)",
+    )
+    ap.add_argument(
+        "--tail", type=int, default=200,
+        help="last N timeline lines (default 200, 0 = all)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable digest")
+    args = ap.parse_args(argv)
+
+    records = load(args.files)
+    if not records:
+        print("no records found", file=sys.stderr)
+        return 1
+
+    headers = [r for r in records if r.get("type") == "flight_header"]
+    alerts = alert_digest(records)
+    rows = timeline(records, trace=args.trace, window=args.window)
+    if args.tail and len(rows) > args.tail:
+        clipped, rows = len(rows) - args.tail, rows[-args.tail:]
+    else:
+        clipped = 0
+
+    if args.json:
+        print(json.dumps({
+            "bundles": [
+                {k: h.get(k) for k in
+                 ("service", "pid", "reason", "detail", "ts", "counts")}
+                for h in headers
+            ],
+            "alerts": alerts,
+            "timeline": rows,
+            "clipped": clipped,
+        }, indent=2, default=str))
+        return 0
+
+    for h in headers:
+        when = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(h.get("ts", 0))
+        )
+        print(
+            f"bundle: service={h.get('service')} pid={h.get('pid')} "
+            f"reason={h.get('reason')} dumped={when} "
+            f"counts={h.get('counts')}"
+            + (f" detail={h.get('detail')}" if h.get("detail") else "")
+        )
+    if alerts:
+        print(f"\n{len(alerts)} alert(s):")
+        for a in alerts:
+            print(f"  [{a['severity']}] {a['rule']}: {a['message']}")
+            if a["trace_id"]:
+                print(f"      trace: {a['trace_id']}"
+                      f"  (re-run with --trace {a['trace_id'][:8]})")
+            print(f"      means: {a['summary']}")
+            if a["runbook"]:
+                print(f"      do:    {a['runbook']}")
+    else:
+        print("\nno alerts recorded")
+    print(
+        f"\ntimeline ({len(rows)} records"
+        + (f", first {clipped} clipped — use --tail 0" if clipped else "")
+        + (f", trace {args.trace}" if args.trace else "")
+        + "):"
+    )
+    for rec in rows:
+        print(render_line(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

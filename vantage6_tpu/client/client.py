@@ -432,6 +432,17 @@ class UtilSubClient:
         HTTP, executor, event-hub, cache and tracing series)."""
         return self.parent.request("GET", "metrics", raw=True)
 
+    def alerts(self) -> dict[str, Any]:
+        """The server watchdog's alert state (GET /api/alerts): active +
+        recently resolved alerts and the rule catalog explaining each."""
+        return self.parent.request("GET", "alerts")
+
+    def debug_dump(self) -> dict[str, Any]:
+        """Trigger a server-side flight-recorder dump (POST
+        /api/debug/dump); returns the bundle path + record census. Feed
+        the path to `tools/doctor.py` for the merged timeline."""
+        return self.parent.request("POST", "debug/dump")
+
     def version(self) -> dict[str, Any]:
         return self.parent.request("GET", "version")
 

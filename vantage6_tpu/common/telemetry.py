@@ -73,6 +73,9 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
     ("v6t_event_hub_evicted_through", "gauge",
      "newest event sequence the bounded buffer has dropped"),
     ("v6t_event_hub_subscribers", "gauge", "in-process push subscribers"),
+    ("v6t_event_truncated_total", "counter",
+     "event fetches answered truncated: the consumer's cursor was behind "
+     "the ring's eviction horizon"),
     # server hot-path caches (server.cache)
     ("v6t_auth_cache_hits_total", "counter", "token->principal cache hits"),
     ("v6t_auth_cache_misses_total", "counter", "token->principal cache misses"),
@@ -88,6 +91,8 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
     ("v6t_executor_pools", "gauge", "live StationExecutor pools in this process"),
     ("v6t_executor_inflight_items", "gauge",
      "run items queued or executing across live pools"),
+    ("v6t_executor_capacity", "gauge",
+     "total worker slots across live pools (queue_buildup denominator)"),
     # gradient compression (fed.compression — docs/compression.md)
     ("v6t_compress_calls_total", "counter",
      "delta compress operations (one per station uplink)"),
@@ -109,11 +114,40 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
      "JSONL sink write failures (sink disabled after the first)"),
     ("v6t_trace_buffer_len", "gauge", "spans currently buffered"),
     ("v6t_trace_enabled", "gauge", "1 when tracing collection is enabled"),
+    # watchdog / alerting (runtime.watchdog — docs/observability.md)
+    ("v6t_alerts_active", "gauge", "watchdog alerts currently active"),
+    ("v6t_alerts_raised_total", "counter",
+     "alert raise transitions (inactive -> active)"),
+    ("v6t_alerts_cleared_total", "counter",
+     "alert clear transitions (active -> resolved)"),
+    ("v6t_watchdog_evaluations_total", "counter",
+     "watchdog rule-evaluation passes"),
+    ("v6t_watchdog_last_eval_unixtime", "gauge",
+     "wall-clock of the last watchdog evaluation"),
+    ("v6t_watchdog_feed_errors_total", "counter",
+     "watchdog feed/rule callbacks that raised (skipped, never fatal)"),
+    ("v6t_health_degraded", "gauge",
+     "1 when the health verdict is degraded (component self-check failure "
+     "or critical alert active)"),
+    # node daemon resilience (node.daemon)
+    ("v6t_daemon_backoff_total", "counter",
+     "event-poll failures that entered the capped exponential backoff"),
+    # flight recorder (common.flight)
+    ("v6t_flight_records", "gauge",
+     "entries currently buffered across the flight-recorder rings"),
+    ("v6t_flight_dumps_total", "counter", "flight-recorder bundles written"),
 ]
 
 _KNOWN: dict[str, tuple[str, str]] = {
     name: (kind, help_) for name, kind, help_ in KNOWN_METRICS
 }
+
+
+def metric_kind(name: str) -> str | None:
+    """Declared kind ("counter"/"gauge"/"histogram") of a KNOWN_METRICS
+    name, None for undeclared series."""
+    entry = _KNOWN.get(name)
+    return entry[0] if entry else None
 
 
 def validate_metric_name(name: str) -> None:
@@ -357,6 +391,7 @@ def _executor_collector() -> dict[str, float]:
     return {
         "v6t_executor_pools": len(pools),
         "v6t_executor_inflight_items": sum(p.inflight for p in pools),
+        "v6t_executor_capacity": sum(p.workers for p in pools),
     }
 
 

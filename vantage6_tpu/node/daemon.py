@@ -195,6 +195,7 @@ class NodeDaemon:
         mode: str = "sandbox",
         poll_interval: float = 0.25,
         sync_interval: float = 15.0,
+        ping_interval: float | None = None,
         name: str = "",
         max_concurrent_runs: int = 4,
         station_secret: str | bytes | None = None,
@@ -240,6 +241,17 @@ class NodeDaemon:
         self.api_key = api_key
         self.poll_interval = poll_interval
         self.sync_interval = sync_interval
+        # ping-window bookkeeping (the server watchdog's daemon_lapsed
+        # rule watches node.last_seen_at): the sync worker POSTs a ping at
+        # least every ping_interval so a live daemon never lapses, and the
+        # counters below tell a dump whether THIS side was failing to ping
+        # or the server was failing to hear
+        self.ping_interval = (
+            min(sync_interval, 10.0) if ping_interval is None
+            else max(0.1, float(ping_interval))
+        )
+        self.last_ping_at: float | None = None
+        self.ping_failures = 0
         self.transport = transport
         self.event_wait = max(0.0, float(event_wait))
         # None = capability unknown; False = server lacks the batch
@@ -358,6 +370,7 @@ class NodeDaemon:
             device_engine=cfg.get("device_engine"),
             transport=cfg.get("transport", "batched"),
             event_wait=cfg.get("event_wait", 2.0),
+            ping_interval=cfg.get("ping_interval"),
             **overrides,
         )
 
@@ -495,6 +508,12 @@ class NodeDaemon:
 
     # ------------------------------------------------------------- lifecycle
     def start(self, background: bool = True) -> "NodeDaemon":
+        # crash forensics: label this process's flight recorder and arm
+        # dump-on-fatal + kill -USR2 (docs/observability.md). Idempotent —
+        # a test process hosting several daemons installs the hooks once.
+        from vantage6_tpu.common.flight import install as flight_install
+
+        flight_install(service=f"daemon:{self.name}")
         self._proxy_server = self.proxy.serve()
         self.request("PATCH", f"node/{self.id}", {"status": "online"})
         self._cursor = self.request("GET", "event", params={"since": 0})[
@@ -599,12 +618,37 @@ class NodeDaemon:
             delay = backoff_delay(
                 max(self.poll_interval, 0.05), self._poll_failures
             )
-            log.warning(
-                "event poll failed (attempt %d, retry in %.2fs): %s",
-                self._poll_failures, delay, e,
+            # ONE warning per failure streak, not one per retry: a server
+            # restart used to spam a warning every backoff step across
+            # every daemon. The streak's shape stays fully recorded — a
+            # telemetry counter per failure and a flight-recorder note per
+            # attempt (the dump shows each retry) — while the console gets
+            # one line on entry and one on recovery.
+            from vantage6_tpu.common.flight import FLIGHT
+            from vantage6_tpu.common.telemetry import REGISTRY
+
+            REGISTRY.counter("v6t_daemon_backoff_total").inc()
+            FLIGHT.note(
+                "event_poll_error", attempt=self._poll_failures,
+                retry_in_s=round(delay, 3), error=str(e),
             )
+            if self._poll_failures == 1:
+                log.warning(
+                    "event poll failed, entering backoff (retry in "
+                    "%.2fs; further retries logged at DEBUG): %s", delay, e,
+                )
+            else:
+                log.debug(
+                    "event poll failed (attempt %d, retry in %.2fs): %s",
+                    self._poll_failures, delay, e,
+                )
             self._stop.wait(delay)
             return True
+        if self._poll_failures:
+            log.info(
+                "event poll recovered after %d failed attempt(s)",
+                self._poll_failures,
+            )
         self._poll_failures = 0
         self._long_poll = bool(batch.get("long_poll"))
         if batch.get("truncated"):
@@ -1002,11 +1046,45 @@ class NodeDaemon:
         whose TERMINAL patch was lost (finished work stuck ACTIVE at the
         server). Orphan reclaim is safe mid-life because anything this
         daemon currently executes is in the claim set and skipped."""
-        while not self._stop.wait(self.sync_interval):
-            try:
-                self._sync_missed_runs()
-            except Exception as e:
-                log.warning("anti-entropy run sweep failed: %s", e)
+        next_sweep = time.monotonic() + self.sync_interval
+        next_ping = time.monotonic()  # first ping immediately
+        while True:
+            now = time.monotonic()
+            # wake exactly at the next due event — pings and sweeps each
+            # keep their OWN cadence instead of quantizing to a shared
+            # tick (a shared tick silently stretched the 15 s sweep to 20)
+            wait = max(0.0, min(next_ping, next_sweep) - now)
+            if self._stop.wait(wait):
+                return
+            now = time.monotonic()
+            if now >= next_ping:
+                next_ping = now + self.ping_interval
+                try:
+                    self.ping()
+                except Exception as e:
+                    # a missed ping window flips the server's
+                    # daemon_lapsed alert — record the miss on THIS side
+                    # too so a dump shows which end was failing
+                    self.ping_failures += 1
+                    from vantage6_tpu.common.flight import FLIGHT
+
+                    FLIGHT.note(
+                        "ping_failed", failures=self.ping_failures,
+                        error=str(e),
+                    )
+                    if self.ping_failures == 1:
+                        log.warning("server ping failed: %s", e)
+            if now >= next_sweep:
+                # fixed cadence (+= not now+): a slow sweep must not
+                # push every later sweep back; if we fell more than one
+                # period behind, re-anchor instead of bursting
+                next_sweep += self.sync_interval
+                if next_sweep <= now:
+                    next_sweep = now + self.sync_interval
+                try:
+                    self._sync_missed_runs()
+                except Exception as e:
+                    log.warning("anti-entropy run sweep failed: %s", e)
 
     def _reconcile_sessions(self) -> None:
         """Drop local session stores whose server session no longer exists.
@@ -1175,6 +1253,13 @@ class NodeDaemon:
                     finished_at=time.time(),
                 )
                 return
+        # inside the daemon.exec span: this record (and everything the run
+        # logs from here on this thread) carries the task's trace_id, the
+        # join key a flight-recorder dump correlates logs to spans with
+        log.info(
+            "run %s: executing %s/%s for task %s", run_id,
+            task.get("image"), task.get("method"), task.get("id"),
+        )
         patch(status=TaskStatus.ACTIVE.value, started_at=time.time())
         if self.vpn.enabled:
             # register the algorithm's declared ports (module EXPOSED_PORTS;
@@ -1322,3 +1407,11 @@ class NodeDaemon:
     # --------------------------------------------------------------- health
     def ping(self) -> None:
         self.request("POST", "ping")
+        self.last_ping_at = time.time()
+        self.ping_failures = 0
+
+    def alerts(self) -> dict[str, Any]:
+        """The server watchdog's alert state (GET /api/alerts) — the
+        daemon-side client of the ops plane, for operators shelling into
+        a station and for tests asserting the federation's health."""
+        return self.request("GET", "alerts")

@@ -187,6 +187,22 @@ class StationExecutor:
         with self._cond:
             return self._inflight
 
+    def stats(self) -> dict[str, Any]:
+        """Queue-depth view for the ops plane (watchdog queue_buildup feed
+        + /api/alerts context): total inflight, worker capacity, and the
+        per-station queue lengths that tell a uniformly-loaded pool from
+        one station's FIFO wedged behind a long run."""
+        with self._cond:
+            return {
+                "inflight": self._inflight,
+                "workers": self.workers,
+                "n_stations": self.n_stations,
+                "queued_per_station": [len(q) for q in self._queues],
+                "executing_stations": [
+                    i for i, t in enumerate(self._executing) if t is not None
+                ],
+            }
+
     # ------------------------------------------------------------- lifecycle
     def close(self) -> None:
         """Tear down the pool. Queued-but-unstarted items are dropped —

@@ -147,6 +147,31 @@ class Federation:
         self._stacked_lock = threading.Lock()   # _stacked_cache builds
         self._identity_lock = threading.Lock()  # lazy RSA keygen
         self._session_lock = threading.Lock()   # session bookkeeping
+        # ------------------------------------------------------- watchdog
+        # feed the process watchdog this federation's run/queue state
+        # (stuck_run + queue_buildup + straggler_station in the simulator
+        # topology, same rules the server feeds from its DB). Weakref
+        # closure: an abandoned Federation must not be pinned alive by the
+        # singleton — a dead ref yields None and close() unregisters.
+        import weakref
+
+        from vantage6_tpu.runtime.watchdog import WATCHDOG
+
+        self._watchdog_key = key = f"federation-{id(self)}"
+        wref = weakref.ref(self)
+
+        def _feed() -> dict[str, Any] | None:
+            fed = wref()
+            if fed is None:
+                # GC'd without close(): reap the registration from inside
+                # its own callback, or abandoned Federations would grow
+                # the singleton's feed table forever
+                WATCHDOG.unregister_feed(key, _feed)
+                return None
+            return fed.watchdog_feed()
+
+        self._watchdog_feed_fn = _feed
+        WATCHDOG.register_feed(key, _feed)
 
     # ------------------------------------------------------------------ data
     def load_all_data(self) -> None:
@@ -927,10 +952,73 @@ class Federation:
             "wire": wire_totals(task.runs),
         }
 
+    def watchdog_feed(self) -> dict[str, Any]:
+        """This federation's state for the watchdog rules
+        (runtime.watchdog): ACTIVE in-flight runs (stuck_run), executor
+        queue depth (queue_buildup — the telemetry gauges cover the
+        totals; this adds per-station queue detail to the feed for
+        operators reading /api/alerts context), and the straggler view of
+        recently finished multi-run tasks (straggler_station)."""
+        now = time.time()
+        with self._inflight_lock:
+            inflight = set(self._inflight_runs)
+        runs = []
+        rounds = []
+        tasks = list(self.tasks.values())
+        # resolve the (small) inflight set by scanning NEWEST tasks first
+        # and stopping once every id is found — the feed runs every
+        # watchdog tick, and a long-lived simulator holds its whole task
+        # history in this dict; O(all runs ever) per tick would make the
+        # watchdog itself the slow component
+        pending = set(inflight)
+        for task in reversed(tasks):
+            if not pending:
+                break
+            for run in task.runs:
+                if run.id not in pending:
+                    continue
+                pending.discard(run.id)
+                if run.status == TaskStatus.ACTIVE:
+                    runs.append({
+                        "run_id": run.id,
+                        "task_id": task.id,
+                        "status": "active",
+                        "assigned_at": run.assigned_at,
+                        "started_at": run.started_at,
+                        "organization_id": run.station_index,
+                    })
+        for task in tasks[-self.config.n_stations * 8:]:
+            if len(task.runs) < 2 or not task.is_finished:
+                continue
+            execs = [
+                (r.station_index, r.finished_at - r.started_at)
+                for r in task.runs
+                if r.started_at is not None and r.finished_at is not None
+            ]
+            if len(execs) < 2:
+                continue
+            durs = [d for _, d in execs]
+            straggler, max_s = max(execs, key=lambda e: e[1])
+            rounds.append({
+                "task_id": task.id,
+                "straggler_station": straggler,
+                "max_exec_s": max_s,
+                "mean_exec_s": sum(durs) / len(durs),
+                "n": len(execs),
+            })
+        executor = self._executor
+        state: dict[str, Any] = {"runs": runs, "rounds": rounds, "now": now}
+        if executor is not None:
+            state["executor"] = executor.stats()
+        return state
+
     # -------------------------------------------------------------- teardown
     def close(self) -> None:
         """Tear down the executor pool (queued-but-unstarted runs are
         dropped). Idempotent; the Federation stays readable."""
+        from vantage6_tpu.runtime.watchdog import WATCHDOG
+
+        WATCHDOG.unregister_feed(self._watchdog_key, self._watchdog_feed_fn)
         if self._executor is not None:
             self._executor.close()
             self._executor = None

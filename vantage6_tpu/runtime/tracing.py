@@ -43,6 +43,8 @@ import time
 from collections import deque
 from typing import Any, Iterator
 
+from vantage6_tpu.common.env import env_float, env_int
+
 TRACEPARENT_HEADER = "traceparent"
 
 _TRACEPARENT_RE = re.compile(
@@ -93,7 +95,7 @@ class Span:
 
     __slots__ = (
         "trace_id", "span_id", "parent_id", "name", "kind", "service",
-        "ts", "dur", "status", "attrs", "thread",
+        "ts", "dur", "status", "attrs", "thread", "events",
     )
 
     def __init__(
@@ -116,6 +118,7 @@ class Span:
         self.status = "ok"
         self.attrs: dict[str, Any] = {}
         self.thread = threading.get_ident()
+        self.events: list[dict[str, Any]] = []
 
     @property
     def context(self) -> SpanContext:
@@ -127,8 +130,15 @@ class Span:
     def set_status(self, status: str) -> None:
         self.status = status
 
+    def add_event(self, name: str, **attrs: Any) -> None:
+        """Attach a point-in-time event to this span (OTel span events):
+        a timestamped marker inside an operation — a watchdog alert firing
+        mid-round, a retry, a cache refusal — that deserves a place on the
+        trace timeline without being an operation of its own."""
+        self.events.append({"name": name, "ts": time.time(), "attrs": attrs})
+
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
@@ -141,6 +151,9 @@ class Span:
             "attrs": self.attrs,
             "thread": self.thread,
         }
+        if self.events:
+            d["events"] = self.events
+        return d
 
 
 class _NullSpan:
@@ -157,19 +170,12 @@ class _NullSpan:
     def set_status(self, status: str) -> None:
         pass
 
+    def add_event(self, name: str, **attrs: Any) -> None:
+        pass
+
 
 NULL_SPAN = _NullSpan()
 _UNSET = object()
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
 
 
 class Tracer:
@@ -194,14 +200,18 @@ class Tracer:
         self.spans_recorded = 0
         self.spans_dropped = 0
         self.sink_errors = 0
+        # keyed span taps (flight recorder, tests): called with every
+        # finished span dict, outside the buffer lock; a raising tap is
+        # dropped silently — observers must never take the data plane down
+        self._taps: dict[str, Any] = {}
         # fail-soft env parsing, same stance as malformed traceparents: a
         # typo'd tuning knob falls back to its default instead of killing
         # every process that imports this module (client, server, daemons)
         self.configure(
             enabled=os.environ.get("V6T_TRACE", "1") != "0",
-            sample=_env_float("V6T_TRACE_SAMPLE", 1.0),
+            sample=env_float("V6T_TRACE_SAMPLE", 1.0),
             sink=os.environ.get("V6T_TRACE_FILE") or None,
-            buffer_size=int(_env_float("V6T_TRACE_BUFFER", 8192)),
+            buffer_size=env_int("V6T_TRACE_BUFFER", 8192),
             service=os.environ.get("V6T_TRACE_SERVICE", "v6t"),
         )
 
@@ -233,6 +243,12 @@ class Tracer:
                             pass
                         self._sink_fh = None
                     self.sink = sink
+                    # re-pointing (or clearing) the sink is the operator's
+                    # heal action: the failure streak it resets is what the
+                    # tracer_sink health check keys on — without this, one
+                    # transient write error pins /api/health degraded for
+                    # the process lifetime
+                    self.sink_errors = 0
         return self
 
     # -------------------------------------------------------------- context
@@ -257,6 +273,18 @@ class Tracer:
         if tp is not None:
             headers.setdefault(TRACEPARENT_HEADER, tp)
         return headers
+
+    # ------------------------------------------------------------------ taps
+    def add_tap(self, key: str, fn: Any) -> None:
+        """Register (or replace — same key) a span observer: `fn(span_dict)`
+        on every finished sampled span. The flight recorder's in-memory
+        span ring is one of these."""
+        with self._lock:
+            self._taps[key] = fn
+
+    def remove_tap(self, key: str) -> None:
+        with self._lock:
+            self._taps.pop(key, None)
 
     @staticmethod
     def _resolve(parent: Any) -> SpanContext | None:
@@ -376,6 +404,13 @@ class Tracer:
                 self.spans_dropped += 1
             self._buf.append(rec)
             self.spans_recorded += 1
+            taps = list(self._taps.values()) if self._taps else None
+        if taps:
+            for tap in taps:
+                try:
+                    tap(rec)
+                except Exception:
+                    pass
         if line is not None:
             try:
                 with self._sink_lock:
@@ -431,6 +466,18 @@ class Tracer:
 
 
 TRACER = Tracer()
+
+
+def current_trace_ids() -> tuple[str, str] | None:
+    """(trace_id, span_id) of the calling thread's active span, or None.
+
+    The accessor `common.log.TraceContextFilter` binds: every log record
+    emitted inside a span carries the ids that correlate it with the trace
+    — the join key the flight recorder and `tools/doctor.py` merge on."""
+    ctx = TRACER.current_context()
+    if ctx is None:
+        return None
+    return ctx.trace_id, ctx.span_id
 
 
 # ------------------------------------------------------------------- export

@@ -1,0 +1,850 @@
+"""Live health watchdog: declarative alert rules over telemetry + run state.
+
+PR 5 built the *attribution* substrate (tracing + the unified telemetry
+registry); this module is the *detection* layer on top of it — the ops
+plane that tells an operator something is wrong while it is still wrong,
+instead of leaving a stuck round to be discovered in `trace_view` after
+the fact.
+
+Design:
+
+- **Rules** (:class:`AlertRule`) are declarative: a snake_case name, a
+  severity, a human summary + runbook line, the telemetry series they
+  read (audited against ``KNOWN_METRICS`` by ``tools/check_collect.py``
+  — a rule referencing an undeclared metric fails CI), and a pure
+  ``check(ctx)`` returning findings.
+- **Context** (:class:`RuleContext`) is everything a rule may look at:
+  the current unified-telemetry snapshot, a bounded per-metric history
+  (for trend rules: queue buildup, EF mass growth, eviction deltas), and
+  the run/node/round **feeds** registered by live components — the
+  server registers its DB view (ACTIVE runs, node ping freshness), an
+  in-process Federation registers its executor/round view. Feeds are
+  keyed (replacement semantics, like telemetry collectors) and fail-soft.
+- **Alerts** are stateful raise/clear transitions, deduplicated on
+  ``(rule, labels)``. A raise emits: a WARNING log line (trace-correlated
+  when the subject has a trace), telemetry counters/gauges
+  (``v6t_alerts_*``), a flight-recorder note, and a trace span — parented
+  on the affected task's own trace when the feed supplies its
+  ``traceparent``, so the alert lands **inside the stuck round's
+  timeline** for `tools/doctor.py` to merge.
+- **Health** — components (event hub, tracer sink, the watchdog's own
+  evaluation loop) register self-checks; :meth:`Watchdog.health` folds
+  them with active critical alerts into the ``ok``/``degraded`` verdict
+  behind the server's upgraded ``GET /api/health``.
+
+The process-wide singleton is :data:`WATCHDOG` (same stance as
+``TRACER``/``REGISTRY``): the server starts its evaluation thread and
+serves its state at ``GET /api/alerts``; simulators and tests register
+feeds and call :meth:`Watchdog.evaluate` directly for determinism.
+
+Env knobs (read at construction; ``configure()`` overrides live):
+``V6T_WATCHDOG_INTERVAL`` (seconds between evaluations, default 5),
+``V6T_RUN_DEADLINE_S`` (stuck-run threshold, default 300),
+``V6T_PING_WINDOW_S`` (daemon lapse threshold, default 60).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from vantage6_tpu.common.env import env_float
+from vantage6_tpu.common.log import setup_logging
+from vantage6_tpu.common.telemetry import REGISTRY, metric_kind as _metric_kind
+from vantage6_tpu.runtime.tracing import TRACER
+
+log = setup_logging("vantage6_tpu/watchdog")
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+SEVERITIES = ("info", "warning", "critical")
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative detection: name, severity, what it means, what to
+    do, which telemetry series it reads, and the check itself.
+
+    ``metrics`` is the audited contract: every name listed here must be
+    declared in ``common.telemetry.KNOWN_METRICS`` (check_collect gate) —
+    a rule silently reading a renamed/undeclared series is exactly the
+    drift the audit exists to catch. Feed-only rules declare ``()``.
+    """
+
+    name: str
+    severity: str
+    summary: str
+    runbook: str
+    metrics: tuple[str, ...]
+    check: Callable[["RuleContext"], list[dict[str, Any]]]
+
+    def validate(self) -> None:
+        if not _NAME_RE.match(self.name):
+            raise ValueError(
+                f"alert rule name {self.name!r} must be snake_case"
+            )
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"alert rule {self.name}: severity {self.severity!r} not in "
+                f"{SEVERITIES}"
+            )
+        if not self.summary or not self.runbook:
+            raise ValueError(
+                f"alert rule {self.name}: summary and runbook are required"
+            )
+
+
+class RuleContext:
+    """What one evaluation pass shows a rule: current metric values, short
+    per-metric history, and every registered feed's state."""
+
+    def __init__(
+        self,
+        snapshot: dict[str, Any],
+        history: dict[str, deque],
+        feeds: dict[str, Any],
+        config: dict[str, Any],
+        now: float,
+    ):
+        self.snapshot = snapshot
+        self._history = history
+        self.feeds = feeds
+        self.config = config
+        self.now = now
+
+    def metric(self, name: str, default: float | None = None) -> float | None:
+        v = self.snapshot.get(name, default)
+        return v if isinstance(v, (int, float)) else default
+
+    def history(self, name: str) -> list[tuple[float, float]]:
+        """Oldest-first (ts, value) samples, one per evaluation."""
+        return list(self._history.get(name, ()))
+
+    def feed_items(self, key: str) -> list[dict[str, Any]]:
+        """Concatenate list-valued entries named ``key`` across every
+        feed — rules stay topology-agnostic (a server feed and a
+        simulator Federation feed both publish "runs")."""
+        out: list[dict[str, Any]] = []
+        for state in self.feeds.values():
+            if isinstance(state, dict):
+                items = state.get(key)
+                if isinstance(items, (list, tuple)):
+                    out.extend(i for i in items if isinstance(i, dict))
+        return out
+
+
+# ------------------------------------------------------------ default rules
+
+
+def _check_stuck_run(ctx: RuleContext) -> list[dict[str, Any]]:
+    deadline = float(ctx.config["run_deadline_s"])
+    findings = []
+    for run in ctx.feed_items("runs"):
+        if run.get("status") != "active":
+            continue
+        base = run.get("started_at") or run.get("assigned_at")
+        if base is None:
+            continue
+        # a run whose status events are still flowing is slow, not stuck —
+        # feeds that track event freshness override the start timestamp
+        last_event = run.get("last_event_ts")
+        if last_event is not None:
+            base = max(base, last_event)
+        age = ctx.now - float(base)
+        if age > deadline:
+            findings.append({
+                "message": (
+                    f"run {run.get('run_id')} of task {run.get('task_id')} "
+                    f"ACTIVE for {age:.1f}s with no status events "
+                    f"(deadline {deadline:g}s)"
+                ),
+                "labels": {
+                    "run_id": run.get("run_id"),
+                    "task_id": run.get("task_id"),
+                },
+                "traceparent": run.get("traceparent"),
+            })
+    return findings
+
+
+def _check_daemon_lapsed(ctx: RuleContext) -> list[dict[str, Any]]:
+    window = float(ctx.config["ping_window_s"])
+    findings = []
+    for node in ctx.feed_items("nodes"):
+        if node.get("status") != "online":
+            continue
+        last = node.get("last_seen_at")
+        if last is None:
+            continue
+        age = ctx.now - float(last)
+        if age > window:
+            findings.append({
+                "message": (
+                    f"node {node.get('node_id')} "
+                    f"({node.get('name') or 'unnamed'}) claims online but "
+                    f"last ping was {age:.1f}s ago (window {window:g}s)"
+                ),
+                "labels": {"node_id": node.get("node_id")},
+            })
+    return findings
+
+
+def _check_straggler_station(ctx: RuleContext) -> list[dict[str, Any]]:
+    need = int(ctx.config["straggler_rounds"])
+    ratio = float(ctx.config["straggler_ratio"])
+    window = int(ctx.config["straggler_window"])
+    rounds = ctx.feed_items("rounds")[-window:]
+    counts: dict[Any, int] = {}
+    worst: dict[Any, float] = {}
+    for r in rounds:
+        station = r.get("straggler_station")
+        mx = r.get("max_exec_s")
+        mean = r.get("mean_exec_s")
+        if station is None or not mx or not mean or r.get("n", 0) < 2:
+            continue
+        if mx / mean >= ratio:
+            counts[station] = counts.get(station, 0) + 1
+            worst[station] = max(worst.get(station, 0.0), mx / mean)
+    return [
+        {
+            "message": (
+                f"station {station} was the straggler in {n} of the last "
+                f"{len(rounds)} rounds (worst {worst[station]:.1f}x the "
+                f"round mean)"
+            ),
+            "labels": {"station": station},
+        }
+        for station, n in counts.items()
+        if n >= need
+    ]
+
+
+def _check_queue_buildup(ctx: RuleContext) -> list[dict[str, Any]]:
+    factor = float(ctx.config["queue_factor"])
+    sustain = int(ctx.config["queue_sustain_evals"])
+    hist = ctx.history("v6t_executor_inflight_items")[-sustain:]
+    if len(hist) < sustain:
+        return []
+    # "sustained" means sustained in WALL CLOCK, not in sample count:
+    # ad-hoc evaluate() calls (close()'s reconcile pass, tests) can land
+    # samples milliseconds apart and would promote a momentary spike to a
+    # sustained backlog. Half the nominal spacing tolerates loop jitter.
+    min_span = 0.5 * (sustain - 1) * float(
+        ctx.config.get("eval_interval_s", 0.0)
+    )
+    if hist[-1][0] - hist[0][0] < min_span:
+        return []
+    capacity = max(1.0, ctx.metric("v6t_executor_capacity", 0.0) or 0.0)
+    threshold = factor * capacity
+    if all(v > threshold for _, v in hist):
+        inflight = hist[-1][1]
+        return [{
+            "message": (
+                f"executor backlog: {inflight:g} items in flight vs "
+                f"{capacity:g} worker slots ({factor:g}x threshold) for "
+                f"{sustain} consecutive evaluations"
+            ),
+            "labels": {},
+        }]
+    return []
+
+
+def _check_event_cursor_lag(ctx: RuleContext) -> list[dict[str, Any]]:
+    # key on ACTUAL truncated fetches (a consumer asked for history the
+    # ring already evicted), not on eviction itself — a busy server's full
+    # ring evicts on every emit as steady state, which proves nothing
+    # strictly consecutive samples: the engine zero-fills this counter's
+    # history while it is still absent from the snapshot, so the first
+    # truncation of a process lifetime shows as a 0 -> 1 step — and a
+    # count predating THIS watchdog's start never reads as a fresh jump
+    hist = ctx.history("v6t_event_truncated_total")
+    if len(hist) < 2:
+        return []
+    prev, cur = hist[-2][1], hist[-1][1]
+    if cur > prev:
+        evicted = ctx.metric("v6t_event_hub_evicted_through", 0.0)
+        cursor = ctx.metric("v6t_event_hub_cursor", 0.0)
+        return [{
+            "message": (
+                f"{cur - prev:g} event fetch(es) answered truncated since "
+                f"the last evaluation (evicted_through {evicted:g}, cursor "
+                f"{cursor:g}): lagging consumers are missing events and "
+                "paying full resyncs"
+            ),
+            "labels": {},
+        }]
+    return []
+
+
+def _check_ef_mass_growth(ctx: RuleContext) -> list[dict[str, Any]]:
+    need = int(ctx.config["ef_growth_evals"])
+    hist = ctx.history("v6t_compress_ef_norm")[-(need + 1):]
+    if len(hist) < need + 1:
+        return []
+    values = [v for _, v in hist]
+    if values[-1] > 0 and all(b > a for a, b in zip(values, values[1:])):
+        return [{
+            "message": (
+                "compression error-feedback mass grew for "
+                f"{need} consecutive evaluations "
+                f"(ef_norm {values[0]:.3g} -> {values[-1]:.3g}): residual "
+                "error is accumulating instead of shipping"
+            ),
+            "labels": {},
+        }]
+    return []
+
+
+def default_rules() -> list[AlertRule]:
+    return [
+        AlertRule(
+            name="stuck_run",
+            severity="critical",
+            summary=(
+                "A run has been ACTIVE past the deadline with no status "
+                "events — its daemon crashed mid-execution, the terminal "
+                "status patch was lost, or the algorithm is wedged."
+            ),
+            runbook=(
+                "doctor the flight dump for the run's trace_id, check the "
+                "owning node's daemon log, then kill_task to release the "
+                "round (the anti-entropy sweep re-claims orphans)."
+            ),
+            metrics=(),
+            check=_check_stuck_run,
+        ),
+        AlertRule(
+            name="daemon_lapsed",
+            severity="critical",
+            summary=(
+                "A node is marked online but missed its ping window — the "
+                "daemon process died or lost its network path without an "
+                "offline handshake."
+            ),
+            runbook=(
+                "restart the node daemon; its startup resync re-claims "
+                "pending runs. Runs it held past the deadline raise "
+                "stuck_run separately."
+            ),
+            metrics=(),
+            check=_check_daemon_lapsed,
+        ),
+        AlertRule(
+            name="straggler_station",
+            severity="warning",
+            summary=(
+                "The same station dominated round wall-clock in several "
+                "recent rounds — persistent slow hardware/data-size skew, "
+                "not a one-off."
+            ),
+            runbook=(
+                "compare the station's exec spans (trace_view straggler "
+                "call-out) against its wire bytes; consider async "
+                "aggregation or re-balancing its shard."
+            ),
+            metrics=(),
+            check=_check_straggler_station,
+        ),
+        AlertRule(
+            name="queue_buildup",
+            severity="warning",
+            summary=(
+                "Executor backlog is sustained at a multiple of worker "
+                "capacity — submission outpaces execution and task latency "
+                "is compounding."
+            ),
+            runbook=(
+                "raise executor_workers, throttle task creation, or check "
+                "for a station whose FIFO is blocked by a long run "
+                "(queue_wait_s in run_lifecycle)."
+            ),
+            metrics=(
+                "v6t_executor_inflight_items",
+                "v6t_executor_capacity",
+            ),
+            check=_check_queue_buildup,
+        ),
+        AlertRule(
+            name="event_cursor_lag",
+            severity="warning",
+            summary=(
+                "Consumers are fetching event history the bounded hub "
+                "buffer already evicted (truncated responses) — lagging "
+                "daemons are missing events and paying full resyncs."
+            ),
+            runbook=(
+                "check daemon backoff counters (a flapping network keeps "
+                "pollers behind) and raise the hub buffer_size if "
+                "truncations persist."
+            ),
+            metrics=(
+                "v6t_event_truncated_total",
+                "v6t_event_hub_cursor",
+                "v6t_event_hub_evicted_through",
+            ),
+            check=_check_event_cursor_lag,
+        ),
+        AlertRule(
+            name="ef_mass_growth",
+            severity="warning",
+            summary=(
+                "The compression error-feedback accumulator is growing "
+                "monotonically — compression is too aggressive for this "
+                "workload and residual error is piling up instead of "
+                "shipping."
+            ),
+            runbook=(
+                "raise topk_ratio (ship more coordinates) or disable int8 "
+                "for this workload; compression_stats() shows the per-round "
+                "trajectory."
+            ),
+            metrics=("v6t_compress_ef_norm",),
+            check=_check_ef_mass_growth,
+        ),
+    ]
+
+
+DEFAULT_RULES = default_rules()
+
+# name -> catalog row: what tools/doctor.py explains alerts against and
+# docs/observability.md documents
+RULE_CATALOG: dict[str, dict[str, str]] = {
+    r.name: {
+        "severity": r.severity,
+        "summary": r.summary,
+        "runbook": r.runbook,
+    }
+    for r in DEFAULT_RULES
+}
+
+
+@dataclasses.dataclass
+class Alert:
+    rule: str
+    severity: str
+    message: str
+    labels: dict[str, Any]
+    traceparent: str | None
+    raised_at: float
+    last_seen_at: float
+    count: int = 1
+    resolved_at: float | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "labels": self.labels,
+            "traceparent": self.traceparent,
+            "raised_at": self.raised_at,
+            "last_seen_at": self.last_seen_at,
+            "count": self.count,
+            "resolved_at": self.resolved_at,
+        }
+
+
+class Watchdog:
+    """Rule engine + evaluation loop + health verdict (module docstring)."""
+
+    def __init__(
+        self,
+        rules: list[AlertRule] | None = None,
+        interval: float | None = None,
+        history: int = 128,
+    ):
+        self._lock = threading.Lock()
+        self.rules: list[AlertRule] = []
+        for rule in rules if rules is not None else default_rules():
+            self.add_rule(rule)
+        self.interval = (
+            interval
+            if interval is not None
+            else max(0.1, env_float("V6T_WATCHDOG_INTERVAL", 5.0))
+        )
+        self.config: dict[str, Any] = {
+            "run_deadline_s": env_float("V6T_RUN_DEADLINE_S", 300.0),
+            "ping_window_s": env_float("V6T_PING_WINDOW_S", 60.0),
+            "queue_factor": 4.0,
+            "queue_sustain_evals": 2,
+            "straggler_rounds": 3,
+            "straggler_ratio": 3.0,
+            "straggler_window": 8,
+            "ef_growth_evals": 4,
+        }
+        self._history_len = max(8, history)
+        self._feeds: dict[str, Callable[[], Any]] = {}  # guarded-by: _lock
+        self._components: dict[str, Callable[[], Any]] = {}  # guarded-by: _lock
+        self._metric_history: dict[str, deque] = {}  # guarded-by: _lock
+        self._active: dict[Any, Alert] = {}  # guarded-by: _lock
+        self._recent: deque[Alert] = deque(maxlen=256)  # guarded-by: _lock
+        self._feed_error_keys: set[str] = set()  # guarded-by: _lock
+        self.last_eval_at: float | None = None
+        self._users = 0  # guarded-by: _lock (refcounted start/stop)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # own freshness as a first-class component: a wedged evaluation
+        # loop must itself flip health to degraded
+        self.register_component("watchdog", self.self_check)
+
+    # ------------------------------------------------------------- registry
+    def add_rule(self, rule: AlertRule) -> None:
+        rule.validate()
+        with self._lock:
+            if any(r.name == rule.name for r in self.rules):
+                raise ValueError(f"duplicate alert rule {rule.name!r}")
+            self.rules.append(rule)
+
+    def configure(self, interval: float | None = None, **config: Any) -> "Watchdog":
+        if interval is not None:
+            self.interval = max(0.05, float(interval))
+        for key, value in config.items():
+            if key not in self.config:
+                raise ValueError(f"unknown watchdog config key {key!r}")
+            self.config[key] = value
+        return self
+
+    def register_feed(self, key: str, fn: Callable[[], Any]) -> None:
+        """Register (or replace — same key) a state source: ``fn()``
+        returns a dict of list-valued entries ("runs", "nodes", "rounds")
+        or None. Same keyed-replacement story as telemetry collectors."""
+        with self._lock:
+            self._feeds[key] = fn
+
+    def unregister_feed(
+        self, key: str, fn: Callable[[], Any] | None = None
+    ) -> None:
+        """Remove a feed; with ``fn``, only if it is still the registered
+        one (a replaced source must not evict its replacement — same
+        contract as telemetry's unregister_collector)."""
+        with self._lock:
+            if fn is None or self._feeds.get(key) == fn:
+                self._feeds.pop(key, None)
+                self._feed_error_keys.discard(key)
+
+    def has_feed(self, key: str) -> bool:
+        with self._lock:
+            return key in self._feeds
+
+    def register_component(self, name: str, fn: Callable[[], Any]) -> None:
+        """Register a health self-check: ``fn()`` returns ``(ok, detail)``
+        or a bare bool. A raising check counts as failed (the component
+        cannot even answer)."""
+        with self._lock:
+            self._components[name] = fn
+
+    def unregister_component(self, name: str) -> None:
+        with self._lock:
+            self._components.pop(name, None)
+
+    # ------------------------------------------------------------ evaluation
+    def _rule_metric_names(self) -> set[str]:
+        return {name for rule in self.rules for name in rule.metrics}
+
+    def evaluate(self) -> list[dict[str, Any]]:
+        """One full pass: snapshot telemetry, pull feeds, run every rule,
+        transition alert state, emit. Returns the active alerts."""
+        now = time.time()
+        snapshot = REGISTRY.snapshot()
+        with self._lock:
+            feeds_fns = dict(self._feeds)
+            tracked = self._rule_metric_names()
+            for name in tracked:
+                value = snapshot.get(name)
+                if value is None and _metric_kind(name) == "counter":
+                    # counters materialize in the snapshot on first inc();
+                    # an absent counter IS 0, and recording that baseline
+                    # is what lets a trend rule see the first increment of
+                    # a process lifetime as growth — without ever
+                    # mistaking a pre-existing count at watchdog start for
+                    # a fresh jump
+                    value = 0.0
+                if isinstance(value, (int, float)):
+                    hist = self._metric_history.get(name)
+                    if hist is None:
+                        hist = self._metric_history[name] = deque(
+                            maxlen=self._history_len
+                        )
+                    hist.append((now, float(value)))
+            history = {
+                k: deque(v) for k, v in self._metric_history.items()
+            }
+        feeds: dict[str, Any] = {}
+        any_feed_failed = False
+        for key, fn in feeds_fns.items():
+            try:
+                state = fn()
+            except Exception as e:
+                REGISTRY.counter("v6t_watchdog_feed_errors_total").inc()
+                any_feed_failed = True
+                with self._lock:
+                    fresh = key not in self._feed_error_keys
+                    self._feed_error_keys.add(key)
+                if fresh:  # once per failure streak, not per eval
+                    log.warning("watchdog feed %s failed: %s", key, e)
+                continue
+            with self._lock:
+                self._feed_error_keys.discard(key)
+            if state is not None:
+                feeds[key] = state
+        # eval_interval_s rides along (NOT a configure() key): trend rules
+        # need the nominal sample spacing to turn "N consecutive samples"
+        # into a wall-clock claim
+        ctx = RuleContext(
+            snapshot, history, feeds,
+            {**self.config, "eval_interval_s": self.interval}, now,
+        )
+
+        proposed: dict[Any, tuple[AlertRule, dict[str, Any]]] = {}
+        crashed_rules: set[str] = set()
+        for rule in list(self.rules):
+            try:
+                findings = rule.check(ctx) or []
+            except Exception as e:
+                REGISTRY.counter("v6t_watchdog_feed_errors_total").inc()
+                crashed_rules.add(rule.name)
+                log.warning("alert rule %s crashed: %s", rule.name, e)
+                continue
+            for finding in findings:
+                labels = finding.get("labels") or {}
+                key = (
+                    rule.name,
+                    tuple(sorted((k, str(v)) for k, v in labels.items())),
+                )
+                proposed[key] = (rule, finding)
+
+        raised: list[Alert] = []
+        cleared: list[Alert] = []
+        with self._lock:
+            for key, (rule, finding) in proposed.items():
+                alert = self._active.get(key)
+                if alert is None:
+                    alert = Alert(
+                        rule=rule.name,
+                        severity=rule.severity,
+                        message=finding["message"],
+                        labels=finding.get("labels") or {},
+                        traceparent=finding.get("traceparent"),
+                        raised_at=now,
+                        last_seen_at=now,
+                    )
+                    self._active[key] = alert
+                    raised.append(alert)
+                else:
+                    alert.message = finding["message"]
+                    alert.last_seen_at = now
+                    alert.count += 1
+            for key in [k for k in self._active if k not in proposed]:
+                # Fail-soft HOLDS, never clears: when a feed raised or the
+                # alert's own rule crashed, the finding's absence is loss
+                # of evidence, not recovery — resolving would flap
+                # /api/health and reset raised_at/count on the next clean
+                # pass. Hold the alert until a clean evaluation stops
+                # proposing it.
+                if any_feed_failed or key[0] in crashed_rules:
+                    continue
+                alert = self._active.pop(key)
+                alert.resolved_at = now
+                self._recent.append(alert)
+                cleared.append(alert)
+            n_active = len(self._active)
+            active = [a.to_dict() for a in self._active.values()]
+            self.last_eval_at = now
+
+        for alert in raised:
+            self._emit_raise(alert)
+        for alert in cleared:
+            self._emit_clear(alert)
+
+        REGISTRY.counter("v6t_watchdog_evaluations_total").inc()
+        if raised:
+            REGISTRY.counter("v6t_alerts_raised_total").inc(len(raised))
+        if cleared:
+            REGISTRY.counter("v6t_alerts_cleared_total").inc(len(cleared))
+        REGISTRY.gauge("v6t_alerts_active").set(n_active)
+        REGISTRY.gauge("v6t_watchdog_last_eval_unixtime").set(now)
+        # fold the verdict into telemetry + the flight recorder's metric
+        # history every pass — a dump carries the health trajectory
+        verdict = self.health()
+        REGISTRY.gauge("v6t_health_degraded").set(
+            1.0 if verdict["status"] == "degraded" else 0.0
+        )
+        try:
+            from vantage6_tpu.common.flight import FLIGHT
+
+            # reuse THIS evaluation's snapshot — taking another would run
+            # every collector (hub/executor/cache stats, each under its
+            # component's lock) twice per tick
+            FLIGHT.snapshot_metrics(snapshot)
+        except Exception:  # pragma: no cover
+            pass
+        return active
+
+    def _emit_raise(self, alert: Alert) -> None:
+        attrs = {
+            "severity": alert.severity,
+            "message": alert.message,
+            **{f"label_{k}": v for k, v in alert.labels.items()},
+        }
+        # the span is ACTIVE around the warning log so the log record is
+        # stamped with the trace ids (TraceContextFilter): when the alert
+        # carries the affected task's traceparent, both the span AND the
+        # log line land inside the stuck round's own trace — the
+        # correlation tools/doctor.py merges on
+        with TRACER.span(
+            f"alert.{alert.rule}", kind="alert", service="watchdog",
+            parent=alert.traceparent,  # None -> fresh root trace
+            attrs=attrs,
+        ) as sp:
+            sp.add_event("alert_raised", rule=alert.rule,
+                         severity=alert.severity)
+            log.warning(
+                "ALERT raised [%s/%s]: %s", alert.severity, alert.rule,
+                alert.message,
+            )
+        try:
+            from vantage6_tpu.common.flight import FLIGHT
+
+            FLIGHT.note(
+                "alert_raised", rule=alert.rule, severity=alert.severity,
+                message=alert.message, labels=alert.labels,
+                traceparent=alert.traceparent,
+            )
+        except Exception:  # pragma: no cover
+            pass
+
+    def _emit_clear(self, alert: Alert) -> None:
+        log.info(
+            "alert cleared [%s/%s] after %.1fs: %s", alert.severity,
+            alert.rule, (alert.resolved_at or 0) - alert.raised_at,
+            alert.message,
+        )
+        try:
+            from vantage6_tpu.common.flight import FLIGHT
+
+            FLIGHT.note(
+                "alert_cleared", rule=alert.rule, severity=alert.severity,
+                labels=alert.labels,
+            )
+        except Exception:  # pragma: no cover
+            pass
+
+    # -------------------------------------------------------------- queries
+    def active_alerts(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [a.to_dict() for a in self._active.values()]
+
+    def recent_alerts(self, limit: int = 50) -> list[dict[str, Any]]:
+        with self._lock:
+            recent = list(self._recent)[-limit:]
+        return [a.to_dict() for a in reversed(recent)]
+
+    def health(self) -> dict[str, Any]:
+        """ok/degraded verdict: every registered component's self-check
+        plus the active alert census. Degraded = any component failing OR
+        any critical alert active."""
+        with self._lock:
+            components = dict(self._components)
+            active = list(self._active.values())
+        comp_out: dict[str, dict[str, Any]] = {}
+        degraded = False
+        for name, fn in components.items():
+            try:
+                result = fn()
+            except Exception as e:
+                result = (False, f"self-check raised: {e}")
+            if isinstance(result, tuple):
+                ok, detail = bool(result[0]), str(result[1])
+            else:
+                ok, detail = bool(result), ""
+            comp_out[name] = {"ok": ok, "detail": detail}
+            degraded |= not ok
+        n_critical = sum(1 for a in active if a.severity == "critical")
+        degraded |= n_critical > 0
+        return {
+            "status": "degraded" if degraded else "ok",
+            "components": comp_out,
+            "alerts": {
+                "active": len(active),
+                "critical": n_critical,
+            },
+        }
+
+    def self_check(self) -> tuple[bool, str]:
+        """The watchdog's own freshness, registered as component
+        "watchdog": started-but-stale (or started-but-dead-thread) fails."""
+        with self._lock:
+            users = self._users
+            thread = self._thread
+            last = self.last_eval_at
+        if users <= 0:
+            return True, "not running (on-demand evaluation)"
+        if thread is None or not thread.is_alive():
+            return False, "evaluation thread is not alive"
+        if last is None:
+            return True, "starting"
+        lag = time.time() - last
+        if lag > max(3.0 * self.interval, 1.0):
+            return False, f"last evaluation {lag:.1f}s ago (interval {self.interval:g}s)"
+        return True, f"last evaluation {lag:.1f}s ago"
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, interval: float | None = None) -> "Watchdog":
+        """Refcounted: each server/daemon embedding calls start() once and
+        stop() on close; the loop runs while any user remains."""
+        if interval is not None:
+            self.configure(interval=interval)
+        with self._lock:
+            self._users += 1
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            # a FRESH loop: the previous loop's timestamp must not count
+            # against the new one's freshness check (a server starting
+            # minutes after the last one stopped would otherwise report
+            # a degraded watchdog until the first tick)
+            self.last_eval_at = None
+            self._stop = threading.Event()
+            # the loop gets ITS OWN stop event as an argument: reading
+            # self._stop lazily inside _loop races a stop()+start() pair
+            # swapping the attribute before the old thread's first read —
+            # the old loop would bind the NEW (unset) event and run
+            # forever beside its replacement
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._stop,),
+                daemon=True, name="v6t-watchdog",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._users = max(0, self._users - 1)
+            if self._users > 0:
+                return
+            thread, self._thread = self._thread, None
+            self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def _loop(self, stop: threading.Event) -> None:
+        # evaluate IMMEDIATELY, then on the interval: a freshly started
+        # server gets a real health verdict (and stale alerts from feeds
+        # that died with a previous embedder get cleared) on its first
+        # request, not after one full interval
+        while True:
+            try:
+                self.evaluate()
+            except Exception:
+                # the loop must survive anything an eval throws; the next
+                # tick tries again and self_check reports staleness if it
+                # keeps failing
+                log.exception("watchdog evaluation crashed")
+            if stop.wait(self.interval):
+                return
+
+
+WATCHDOG = Watchdog()

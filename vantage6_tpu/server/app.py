@@ -65,10 +65,67 @@ class ServerApp:
         from vantage6_tpu.common.telemetry import REGISTRY
 
         REGISTRY.register_collector("server", self._telemetry_collector)
+        # live health watchdog (runtime.watchdog): this server feeds the
+        # process singleton its DB view (ACTIVE runs for stuck_run, node
+        # ping freshness for daemon_lapsed) and registers the self-checks
+        # behind the /api/health verdict. Keyed registration — a newer
+        # ServerApp in the same process replaces this one's feed — and the
+        # evaluation thread is refcounted (started here, stopped in close).
+        from vantage6_tpu.runtime.watchdog import WATCHDOG
+
+        self.watchdog = WATCHDOG
+        WATCHDOG.register_feed("server", self._watchdog_feed)
+        WATCHDOG.register_component("event_hub", self._hub_check)
+        WATCHDOG.register_component("tracer_sink", _tracer_sink_check)
+        WATCHDOG.start()
         register_resources(self)
         from vantage6_tpu.server.ui import register_ui
 
         register_ui(self)
+
+    def _watchdog_feed(self) -> dict[str, Any]:
+        """The server's run/node state for the watchdog rules: every
+        ACTIVE run (with the task's traceparent so a stuck_run alert lands
+        on the round's own trace) and every online node's ping freshness.
+        Runs on the watchdog thread — db.py keeps one sqlite connection
+        per thread for exactly this access pattern."""
+        if models.Model.db is None:  # closed mid-evaluation
+            return {}
+        runs = []
+        task_tp: dict[int, str | None] = {}
+        for run in models.TaskRun.list(status="active"):
+            if run.task_id not in task_tp:
+                task = models.Task.get(run.task_id)
+                task_tp[run.task_id] = task.traceparent if task else None
+            runs.append({
+                "run_id": run.id,
+                "task_id": run.task_id,
+                "status": "active",
+                "assigned_at": run.assigned_at,
+                "started_at": run.started_at,
+                "organization_id": run.organization_id,
+                "node_id": run.node_id,
+                "traceparent": task_tp[run.task_id],
+            })
+        nodes = [
+            {
+                "node_id": n.id,
+                "name": n.name,
+                "status": n.status or "offline",
+                "last_seen_at": n.last_seen_at,
+            }
+            for n in models.Node.list(status="online")
+        ]
+        return {"runs": runs, "nodes": nodes}
+
+    def _hub_check(self) -> tuple[bool, str]:
+        try:
+            stats = self.hub.stats()
+        except Exception as e:  # pragma: no cover - hub is in-process
+            return False, f"event hub stats raised: {e}"
+        return True, (
+            f"buffer {stats['buffer_len']}, cursor {stats['cursor']}"
+        )
 
     def _telemetry_collector(self) -> dict[str, float]:
         hub = self.hub.stats()
@@ -88,7 +145,13 @@ class ServerApp:
 
     def close(self) -> None:
         """Stop attached bridges and release the database binding (required
-        before a new ServerApp in the same process — see models.init)."""
+        before a new ServerApp in the same process — see models.init).
+        Idempotent: the watchdog's evaluation thread is refcounted, so a
+        second close() must not decrement again (it would stop a newer
+        embedder's loop in the same process)."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         for bridge in list(self._bridges):
             try:
                 bridge.stop()
@@ -98,7 +161,23 @@ class ServerApp:
         # symmetric with __init__'s register: a closed server must not
         # keep reporting (or be pinned alive by) the telemetry registry
         from vantage6_tpu.common.telemetry import REGISTRY
+        from vantage6_tpu.runtime.watchdog import WATCHDOG
 
+        # only if still ours: a newer ServerApp may have replaced the feed
+        # (keyed registration — same story as the telemetry collector);
+        # the shared components go only when no server feed remains at all
+        WATCHDOG.unregister_feed("server", self._watchdog_feed)
+        if not WATCHDOG.has_feed("server"):
+            WATCHDOG.unregister_component("event_hub")
+            WATCHDOG.unregister_component("tracer_sink")
+        # reconcile once with the feed gone: alerts THIS server's state
+        # raised are proposed by nothing anymore and clear now, instead of
+        # haunting the singleton until some future embedder's first tick
+        try:
+            WATCHDOG.evaluate()
+        except Exception:  # pragma: no cover - teardown must not fail
+            pass
+        WATCHDOG.stop()
         REGISTRY.unregister_collector("server", self._telemetry_collector)
         self.db.close()
         models.Model.db = None
@@ -151,10 +230,31 @@ class ServerApp:
         return server
 
 
+def _tracer_sink_check() -> tuple[bool, str]:
+    """Tracer health for /api/health: a configured-then-failed span sink
+    means trace evidence is being lost — degraded, not fatal."""
+    from vantage6_tpu.runtime.tracing import TRACER
+
+    stats = TRACER.stats()
+    if stats["sink_errors"] > 0:
+        return False, (
+            f"JSONL span sink disabled after {stats['sink_errors']} write "
+            "failure(s); spans continue in the ring buffer only"
+        )
+    return True, (
+        f"{stats['spans_recorded']} spans recorded, "
+        f"{stats['spans_dropped']} evicted"
+    )
+
+
 def run_server(ctx: ServerContext, background: bool = False) -> AppServer:
     """Start a server from an instance context (reference: `v6 server start`)."""
+    from vantage6_tpu.common.flight import install as flight_install
     from vantage6_tpu.server.mail import mailer_from_config
 
+    # arm crash forensics for the server process: dump the flight rings on
+    # any uncaught exception or `kill -USR2` (docs/observability.md)
+    flight_install(service="server")
     srv = ServerApp(
         uri=ctx.uri,
         jwt_secret=ctx.config.get("jwt_secret") or None,

@@ -204,11 +204,19 @@ def register_resources(srv: "ServerApp") -> None:
     # ------------------------------------------------------------- service
     @app.route("/api/health")
     def health(req: Request):
+        """Real health verdict, not just a capability card: `status` is
+        "ok" or "degraded" — degraded when any registered component
+        (event hub, tracer sink, the watchdog's own evaluation loop)
+        fails its self-check, or a critical alert is active. The
+        capability flags the clients probe stay unchanged."""
         from vantage6_tpu import __version__
         from vantage6_tpu.runtime.tracing import TRACER
 
+        verdict = srv.watchdog.health()
         return {
-            "status": "ok",
+            "status": verdict["status"],
+            "components": verdict["components"],
+            "alerts": {**verdict["alerts"], "url": "/api/alerts"},
             "uptime": time.time() - srv.started_at,
             "version": __version__,
             # advertised so nodes/UIs can upgrade from polling to push
@@ -218,6 +226,39 @@ def register_resources(srv: "ServerApp") -> None:
             "metrics": "/api/metrics",
             "tracing": TRACER.enabled,
         }
+
+    @app.route("/api/alerts")
+    def alerts(req: Request):
+        """Watchdog alert state: active alerts, recently resolved ones,
+        and the rule catalog (what each alert means + its runbook line).
+        Unauthenticated like /api/health and /api/metrics — it carries
+        operational state (rule names, run/node ids), never payloads or
+        principals."""
+        from vantage6_tpu.runtime.watchdog import RULE_CATALOG
+
+        return {
+            "status": srv.watchdog.health()["status"],
+            "active": srv.watchdog.active_alerts(),
+            "recent": srv.watchdog.recent_alerts(
+                limit=min(200, max(1, req.int_arg("limit", 50)))
+            ),
+            "rules": RULE_CATALOG,
+        }
+
+    @app.route("/api/debug/dump", methods=("POST",))
+    def debug_dump(req: Request):
+        """Dump this server process's flight recorder to a JSONL bundle
+        (crash forensics on demand — the REST twin of `kill -USR2`).
+        User-only: each call writes a fresh file to server disk, so a
+        compromised node/container credential must not be able to fill
+        the disk one bundle at a time — operators dump, stations don't."""
+        _require_user(srv, req)
+        from vantage6_tpu.common.flight import FLIGHT
+
+        path = FLIGHT.dump(reason="api")
+        if path is None:
+            raise HTTPError(500, "flight dump failed (disk unwritable?)")
+        return {"path": path, "counts": FLIGHT.stats()}, 201
 
     @app.route("/api/metrics")
     def metrics(req: Request):
@@ -1565,6 +1606,13 @@ def register_resources(srv: "ServerApp") -> None:
             events, cursor, truncated = srv.hub.collect(
                 since, rooms, timeout=wait, names=names
             )
+        if truncated:
+            # the watchdog's event_cursor_lag signal: a consumer ACTUALLY
+            # asked for history the ring already evicted (eviction alone
+            # is steady-state on any busy server and proves nothing)
+            from vantage6_tpu.common.telemetry import REGISTRY
+
+            REGISTRY.counter("v6t_event_truncated_total").inc()
         return {
             "cursor": cursor,
             "data": [e.to_dict() for e in events],

@@ -228,12 +228,24 @@ class App:
                         _HTTP_SECONDS.observe(time.perf_counter() - t0)
                     return Response({"msg": e.msg}, e.status)
                 except Exception:
+                    # the log record carries trace_id/span_id (the handler
+                    # span is current here — TraceContextFilter), so this
+                    # 500 is joinable to the request's trace in a dump
                     log.error(
                         "500 on %s %s\n%s",
                         request.method,
                         request.path,
                         traceback.format_exc(limit=8),
                     )
+                    try:
+                        from vantage6_tpu.common.flight import FLIGHT
+
+                        FLIGHT.note(
+                            "http_500", method=request.method,
+                            path=request.path, route=pattern,
+                        )
+                    except Exception:  # pragma: no cover
+                        pass
                     span.set_status("error")
                     _HTTP_ERRORS.inc()
                     if observe:
