@@ -1057,6 +1057,7 @@ def worker_observability() -> None:
     from vantage6_tpu.common.enums import TaskStatus
     from vantage6_tpu.common.log import disable_json_sink, enable_json_sink
     from vantage6_tpu.node.daemon import NodeDaemon
+    from vantage6_tpu.runtime.profiling import DEVICE_OBS
     from vantage6_tpu.runtime.tracing import (
         TRACER, summarize, to_trace_events,
     )
@@ -1114,16 +1115,19 @@ def worker_observability() -> None:
         return srv, http, client, orgs, collab, daemons
 
     def arm(mode: str, arm_tag: str) -> dict:
-        # three alternated arms: "off" (no instrumentation), "trace"
+        # four alternated arms: "off" (no instrumentation), "trace"
         # (distributed tracing — the PR-5 configuration, so overhead_pct
         # keeps its historical meaning), "ops" (tracing + watchdog at an
         # operator cadence + JSON logging + flight taps — the full ops
         # plane; ops_overhead_pct vs the trace arm isolates what THIS
-        # layer adds)
+        # layer adds), "obsy" (ops + the device observatory armed —
+        # observatory_overhead_pct vs the ops arm isolates the device-
+        # plane instrumentation, the observatory PR's <5% acceptance)
         tracing_on = mode != "off"
         TRACER.configure(enabled=tracing_on, sample=1.0)
         TRACER.clear()
-        if mode == "ops":
+        DEVICE_OBS.configure(enabled=mode == "obsy")
+        if mode in ("ops", "obsy"):
             WATCHDOG.configure(interval=OBS_WD_ARM_INTERVAL)
             enable_json_sink(os.path.join(tmp, f"log-{arm_tag}.jsonl"))
         else:
@@ -1348,8 +1352,108 @@ def worker_observability() -> None:
             srv.close()
         return out
 
+    def retrace_storm_smoke() -> dict:
+        """Seed a retrace storm (shape-perturbed re-dispatch of one
+        observed function) and prove the observatory NAMES it three ways:
+        the recompile_storm alert (within one watchdog interval of the
+        storm), the device.compile spans (retrace + signature diff +
+        XLA memory/cost introspection), and the doctor perf digest of a
+        flight dump."""
+        import subprocess
+
+        import jax
+        import jax.numpy as jnp
+
+        from vantage6_tpu.common.flight import FLIGHT
+        from vantage6_tpu.runtime.profiling import observed_jit
+
+        TRACER.configure(enabled=True, sample=1.0)
+        TRACER.clear()
+        DEVICE_OBS.configure(enabled=True)
+        DEVICE_OBS.clear()
+        FLIGHT.clear()
+        WATCHDOG.configure(interval=OBS_WD_INTERVAL)
+        WATCHDOG.start()
+        out: dict = {}
+        try:
+            quiet_before = not any(
+                a["rule"] == "recompile_storm"
+                for a in WATCHDOG.evaluate()
+            )
+            time.sleep(2 * OBS_WD_INTERVAL)  # baseline history on the books
+            storm_fn = observed_jit(
+                "bench.storm_fn", lambda x: jnp.tanh(x @ x.T).sum()
+            )
+            with TRACER.span("bench.retrace_storm", kind="bench") as root:
+                storm_trace = root.context.trace_id
+                # the classic storm: a data-dependent dimension wobbling
+                # per dispatch, every call a fresh abstract signature
+                for i in range(6):
+                    jax.block_until_ready(storm_fn(jnp.ones((8 + i, 4))))
+            storm_done = time.monotonic()
+            detect_deadline = storm_done + 4 * OBS_WD_INTERVAL + 2.0
+            alert = None
+            while time.monotonic() < detect_deadline and alert is None:
+                alert = next(
+                    (a for a in WATCHDOG.active_alerts()
+                     if a["rule"] == "recompile_storm"), None,
+                )
+                if alert is None:
+                    time.sleep(0.05)
+            detect_s = time.monotonic() - storm_done
+            budget_s = 2 * OBS_WD_INTERVAL + 0.5  # one interval + poll slack
+            spans = TRACER.drain(storm_trace)
+            compile_spans = [
+                s for s in spans if s["name"] == "device.compile"
+            ]
+            retrace_spans = [
+                s for s in compile_spans if s["attrs"].get("retrace")
+            ]
+            dump_path = FLIGHT.dump(reason="bench-storm")
+            doctor = subprocess.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "doctor.py",
+                ), dump_path],
+                capture_output=True, text=True, timeout=60,
+            )
+            diffs = [
+                s["attrs"].get("changed") for s in retrace_spans
+                if s["attrs"].get("changed")
+            ]
+            out = {
+                "quiet_before_storm": quiet_before,
+                "n_compiles": len(compile_spans),
+                "n_retrace_spans": len(retrace_spans),
+                "spans_carry_xla_introspection": bool(compile_spans) and all(
+                    "compile_ms" in s["attrs"]
+                    and "temp_bytes" in s["attrs"]
+                    and "flops" in s["attrs"]
+                    for s in compile_spans
+                ),
+                "signature_diffs": diffs[:3],
+                "alert_raised": alert is not None,
+                "alert_names_function": bool(
+                    alert and "bench.storm_fn" in alert["message"]
+                ),
+                "alert_message": alert["message"] if alert else None,
+                "detect_s": round(detect_s, 2),
+                "detect_budget_s": round(budget_s, 2),
+                "within_one_interval": alert is not None
+                and detect_s <= budget_s,
+                "flight_bundle": dump_path,
+                "doctor_names_function_and_diff": (
+                    doctor.returncode == 0
+                    and "bench.storm_fn" in doctor.stdout
+                    and any(d in doctor.stdout for d in diffs)
+                ),
+            }
+        finally:
+            WATCHDOG.stop()
+        return out
+
     try:
-        offs, ons, opss = [], [], []
+        offs, ons, opss, obsys = [], [], [], []
         traced: dict = {}
         for rep in range(max(1, int(os.environ.get(
             "BENCH_OBS_REPS", str(OBS_REPS)
@@ -1359,20 +1463,29 @@ def worker_observability() -> None:
             traced = on  # keep the freshest traced-arm evidence
             ons.append(on)
             opss.append(arm("ops", f"ops{rep}"))
+            obsys.append(arm("obsy", f"obsy{rep}"))
         watchdog_smoke = fault_smoke()
+        storm_smoke = retrace_storm_smoke()
     finally:
         TRACER.configure(enabled=True, sample=1.0)
         disable_json_sink()
+        DEVICE_OBS.configure(enabled=True)
         WATCHDOG.configure(
             interval=5.0, run_deadline_s=300.0, ping_window_s=60.0,
         )
     best_off = max(a["tasks_per_sec"] for a in offs)
     best_on = max(a["tasks_per_sec"] for a in ons)
     best_ops = max(a["tasks_per_sec"] for a in opss)
+    best_obsy = max(a["tasks_per_sec"] for a in obsys)
     overhead_pct = round(100.0 * (best_off - best_on) / best_off, 2)
     # what the WATCHDOG PR adds on top of tracing (the "<5% watchdog +
     # JSON logging" acceptance): ops arm vs trace arm, best-of each
     ops_overhead_pct = round(100.0 * (best_on - best_ops) / best_on, 2)
+    # what the DEVICE OBSERVATORY adds on top of the full ops plane
+    # (this PR's <5% acceptance): observatory arm vs ops arm, best-of
+    observatory_overhead_pct = round(
+        100.0 * (best_ops - best_obsy) / best_ops, 2
+    )
     print(json.dumps({
         "n_daemons": n_daemons,
         "n_tasks": n_tasks,
@@ -1380,15 +1493,21 @@ def worker_observability() -> None:
         "tasks_per_sec_tracing_off": best_off,
         "tasks_per_sec_tracing_on": best_on,
         "tasks_per_sec_ops_plane": best_ops,
+        "tasks_per_sec_observatory": best_obsy,
         "overhead_pct": overhead_pct,
         "overhead_ok": overhead_pct < OBS_OVERHEAD_PCT,
         "ops_overhead_pct": ops_overhead_pct,
         "ops_overhead_ok": ops_overhead_pct < OBS_OVERHEAD_PCT,
+        "observatory_overhead_pct": observatory_overhead_pct,
+        "observatory_overhead_ok": (
+            observatory_overhead_pct < OBS_OVERHEAD_PCT
+        ),
         "overhead_budget_pct": OBS_OVERHEAD_PCT,
         "ops_plane_in_ops_arm": ["tracing", "watchdog", "json_logging",
                                  "flight_taps"],
+        "observatory_in_obsy_arm": ["ops_plane", "device_observatory"],
         "parity_ok": all(
-            a["parity_ok"] for a in offs + ons + opss
+            a["parity_ok"] for a in offs + ons + opss + obsys
         ),
         "trace": {
             k: traced.get(k)
@@ -1398,6 +1517,7 @@ def worker_observability() -> None:
             )
         },
         "watchdog": watchdog_smoke,
+        "retrace_storm": storm_smoke,
     }))
 
 

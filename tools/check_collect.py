@@ -84,6 +84,9 @@ _ROUTE_AUDIT: dict[str, list[str]] = {
         "vantage6_tpu/node/daemon.py",
     ],
     "debug/dump": ["vantage6_tpu/client/client.py"],
+    # device observatory (docs/observability.md "device plane"): the
+    # on-demand jax.profiler window the client util opens
+    "debug/profile": ["vantage6_tpu/client/client.py"],
 }
 
 
@@ -183,6 +186,60 @@ def check_telemetry_metrics() -> list[str]:
             )
         if not help_:
             problems.append(f"metric {name!r} has no help string")
+    return problems
+
+
+def check_device_observatory() -> list[str]:
+    """Audit the device-observatory surface (runtime/profiling.py,
+    docs/observability.md "device plane"):
+
+    - every ``v6t_jit_*`` / ``v6t_engine_cache_*`` metric declared in
+      KNOWN_METRICS is actually emitted by runtime/profiling.py (named as
+      a string literal there) — a declared-but-never-emitted series is
+      documentation lying about the scrape;
+    - every ``v6t_jit_*`` / ``v6t_engine_cache_*`` literal profiling.py
+      emits is declared — the inverse drift (an undeclared series renders
+      untyped and escapes this audit forever);
+    - the ``/api/debug/profile`` route is in the route-audit map above,
+      so the endpoint/call-site agreement check covers it.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import KNOWN_METRICS
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import telemetry registry: {e!r}"]
+    path = os.path.join(
+        _REPO_ROOT, "vantage6_tpu", "runtime", "profiling.py"
+    )
+    try:
+        source = open(path).read()
+    except OSError as e:
+        return [f"cannot read runtime/profiling.py: {e}"]
+    prefixes = ("v6t_jit_", "v6t_engine_cache_")
+    declared = {
+        name for name, _kind, _help in KNOWN_METRICS
+        if name.startswith(prefixes)
+    }
+    emitted = set(re.findall(r'"(v6t_(?:jit|engine_cache)_[a-z0-9_]*)"',
+                             source))
+    for name in sorted(declared - emitted):
+        problems.append(
+            f"metric {name!r} declared in KNOWN_METRICS but never emitted "
+            "by runtime/profiling.py"
+        )
+    for name in sorted(emitted - declared):
+        problems.append(
+            f"runtime/profiling.py emits {name!r} which is not declared "
+            "in KNOWN_METRICS (common/telemetry.py)"
+        )
+    if "debug/profile" not in _ROUTE_AUDIT:
+        problems.append(
+            "the /api/debug/profile route is missing from the route-audit "
+            "map (_ROUTE_AUDIT) — the endpoint/call-site agreement check "
+            "no longer covers the profile window"
+        )
     return problems
 
 
@@ -394,6 +451,17 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    obs_problems = check_device_observatory()
+    if obs_problems:
+        sys.stderr.write(
+            "DEVICE OBSERVATORY DRIFT: the declared v6t_jit_*/"
+            "v6t_engine_cache_* surface and runtime/profiling.py disagree "
+            "(docs/observability.md):\n"
+        )
+        for p in obs_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     note_bench_trend()
 
     lint_problems = check_static_analysis()
@@ -447,6 +515,8 @@ def main(argv: list[str]) -> int:
         print("telemetry audit ok: metric names unique and snake_case")
         print("alert-rule audit ok: watchdog rules named, cataloged, and "
               "reading only declared metrics")
+        print("device-observatory audit ok: v6t_jit_*/v6t_engine_cache_* "
+              "declared <-> emitted, profile route audited")
         print("static analysis ok: v6lint found no unwaived violations")
         print(f"collection clean: {counted} tests collected")
         return 0

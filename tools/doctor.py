@@ -62,14 +62,17 @@ def load(paths: list[str]) -> list[dict[str, Any]]:
             continue
         if recs:
             for r in recs:
-                r.setdefault("_file", os.path.basename(path))
+                # the path AS GIVEN, not its basename: perf_digest groups
+                # per-process series by this, and host1/flight.jsonl +
+                # host2/flight.jsonl must stay distinct sources
+                r.setdefault("_file", path)
             records.extend(recs)
             continue
         # not a bundle (or empty): try it as a raw span JSONL sink
         try:
             for sp in read_spans(path):
                 records.append({
-                    "type": "span", "_file": os.path.basename(path), **sp
+                    "type": "span", "_file": path, **sp
                 })
         except OSError:
             pass
@@ -135,6 +138,109 @@ def alert_digest(records: list[dict[str, Any]]) -> list[dict[str, Any]]:
     sev_rank = {"critical": 0, "warning": 1, "info": 2}
     out.sort(key=lambda a: (sev_rank.get(str(a["severity"]), 3), a["rule"]))
     return out
+
+
+def perf_digest(records: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Device-plane economics of the bundle (docs/observability.md):
+    compile/retrace counts and seconds from the metric snapshots' first→
+    last trajectory, every named retrace (function + the leaf that
+    changed) from the flight notes, engine-cache hit rates, the device
+    memory trend, and any profile-window artifacts. None when the bundle
+    predates the observatory (no v6t_jit_* series, no retrace notes)."""
+    snaps = sorted(
+        (r for r in records
+         if r.get("type") == "metrics" and isinstance(r.get("values"), dict)),
+        key=lambda r: r.get("ts") or 0,
+    )
+    # first→last per SOURCE bundle, then summed: each process's counters
+    # are independent, and differencing an interleaved multi-bundle merge
+    # (doctor server.jsonl daemon.jsonl) across processes would produce
+    # nonsense deltas (server's compiles=50 followed by daemon's =2
+    # reading as -48)
+    series: dict[str, tuple[float, float]] = {}
+    for name in (
+        "v6t_jit_compiles_total", "v6t_jit_retraces_total",
+        "v6t_jit_compile_seconds_total", "v6t_jit_signatures",
+        "v6t_engine_cache_hits_total", "v6t_engine_cache_misses_total",
+        "v6t_engine_cache_entries", "v6t_device_mem_bytes_in_use",
+    ):
+        per_source: dict[str, tuple[float, float]] = {}
+        for s in snaps:
+            v = s["values"].get(name)
+            if not isinstance(v, (int, float)):
+                continue
+            src = str(s.get("_file", ""))
+            first = per_source.get(src, (v, v))[0]
+            per_source[src] = (first, v)
+        if per_source:
+            series[name] = (
+                sum(f for f, _ in per_source.values()),
+                sum(last for _, last in per_source.values()),
+            )
+    retraces = [
+        {"ts": r.get("ts"), "function": r.get("function"),
+         "changed": r.get("changed")}
+        for r in records
+        if r.get("type") == "note" and r.get("kind") == "retrace"
+    ]
+    profiles = [
+        {"ts": r.get("ts"), "path": r.get("path"),
+         "trace_id": r.get("trace_id")}
+        for r in records
+        if r.get("type") == "note" and r.get("kind") == "profile_window"
+    ]
+    if not series and not retraces and not profiles:
+        return None
+    out: dict[str, Any] = {
+        "retraces": retraces,
+        "profile_windows": profiles,
+    }
+    for name, (first, last) in series.items():
+        out[name] = {"first": first, "last": last, "delta": last - first}
+    hits = series.get("v6t_engine_cache_hits_total", (0, 0))[1]
+    misses = series.get("v6t_engine_cache_misses_total", (0, 0))[1]
+    if hits + misses > 0:
+        out["engine_cache_hit_rate"] = round(hits / (hits + misses), 3)
+    return out
+
+
+def render_perf(perf: dict[str, Any]) -> list[str]:
+    lines = ["\ndevice-plane perf digest:"]
+    comp = perf.get("v6t_jit_compiles_total")
+    secs = perf.get("v6t_jit_compile_seconds_total")
+    if comp:
+        lines.append(
+            f"  compiles: {comp['delta']:g} in this bundle's window "
+            f"({comp['last']:g} process-total"
+            + (f", {secs['delta']:.2f}s compiling" if secs else "")
+            + ")"
+        )
+    retr = perf.get("v6t_jit_retraces_total")
+    if retr and retr["delta"] > 0:
+        lines.append(
+            f"  RETRACES: {retr['delta']:g} — same function, new abstract "
+            "signature; every one pays a full XLA compile:"
+        )
+    for r in perf.get("retraces") or []:
+        lines.append(
+            f"    retrace {r.get('function')}: {r.get('changed') or '?'}"
+        )
+    rate = perf.get("engine_cache_hit_rate")
+    if rate is not None:
+        lines.append(f"  engine-cache hit rate: {100 * rate:.1f}%")
+    mem = perf.get("v6t_device_mem_bytes_in_use")
+    if mem:
+        lines.append(
+            f"  device memory in use: {mem['first']:g} -> {mem['last']:g} "
+            f"bytes ({mem['delta']:+g})"
+        )
+    for p in perf.get("profile_windows") or []:
+        lines.append(
+            f"  profile window: {p.get('path')}"
+            + (f" (trace {str(p.get('trace_id'))[:8]})"
+               if p.get("trace_id") else "")
+        )
+    return lines
 
 
 def timeline(
@@ -236,6 +342,7 @@ def main(argv: list[str]) -> int:
 
     headers = [r for r in records if r.get("type") == "flight_header"]
     alerts = alert_digest(records)
+    perf = perf_digest(records)
     rows = timeline(records, trace=args.trace, window=args.window)
     if args.tail and len(rows) > args.tail:
         clipped, rows = len(rows) - args.tail, rows[-args.tail:]
@@ -250,6 +357,7 @@ def main(argv: list[str]) -> int:
                 for h in headers
             ],
             "alerts": alerts,
+            "perf": perf,
             "timeline": rows,
             "clipped": clipped,
         }, indent=2, default=str))
@@ -277,6 +385,9 @@ def main(argv: list[str]) -> int:
                 print(f"      do:    {a['runbook']}")
     else:
         print("\nno alerts recorded")
+    if perf:
+        for line in render_perf(perf):
+            print(line)
     print(
         f"\ntimeline ({len(rows)} records"
         + (f", first {clipped} clipped — use --tail 0" if clipped else "")

@@ -76,6 +76,36 @@ def render_table(summary: dict) -> str:
         ]
         if pct is not None:
             lines.append(f"  cost vs exec total: {pct}%")
+    device = summary.get("device_plane")
+    if device:
+        lines += [
+            "",
+            "device plane (device.compile / device.profile):",
+            f"  compiles   {device['n_compiles']:>6}  "
+            f"({device['compile_total_ms']:.3f} ms total, "
+            f"{device['n_retraces']} retrace(s))",
+        ]
+        if device.get("peak_temp_bytes"):
+            lines.append(
+                f"  peak temp  {device['peak_temp_bytes']:>10} bytes "
+                "(XLA memory_analysis)"
+            )
+        for fn, row in sorted(
+            (device.get("by_function") or {}).items(),
+            key=lambda kv: -kv[1]["total_ms"],
+        ):
+            lines.append(
+                f"  {fn:<28} {row['compiles']} compile(s) "
+                f"{row['total_ms']:>10.3f} ms"
+                + (f"  ({row['retraces']} retrace(s))"
+                   if row["retraces"] else "")
+            )
+        for r in device.get("retraces") or []:
+            lines.append(
+                f"  RETRACE {r['function']}: {r.get('changed') or '?'}"
+            )
+        for log_dir in device.get("profile_windows") or []:
+            lines.append(f"  profile window: {log_dir}")
     return "\n".join(lines)
 
 

@@ -443,6 +443,16 @@ class UtilSubClient:
         the path to `tools/doctor.py` for the merged timeline."""
         return self.parent.request("POST", "debug/dump")
 
+    def debug_profile(self, seconds: float = 1.0) -> dict[str, Any]:
+        """Open an on-demand jax.profiler window on the server (POST
+        /api/debug/profile); returns ``{"path", "seconds", "trace_id"}``
+        — the Perfetto session lands at ``path`` on server disk and is
+        linked to this request's trace. One window at a time (409 while
+        one is open)."""
+        return self.parent.request(
+            "POST", "debug/profile", {"seconds": seconds}
+        )
+
     def version(self) -> dict[str, Any]:
         return self.parent.request("GET", "version")
 

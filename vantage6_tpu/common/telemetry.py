@@ -136,6 +136,49 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
     ("v6t_flight_records", "gauge",
      "entries currently buffered across the flight-recorder rings"),
     ("v6t_flight_dumps_total", "counter", "flight-recorder bundles written"),
+    # device observatory (runtime.profiling — docs/observability.md
+    # "device plane"): every jit entry point's compile/retrace economics
+    ("v6t_jit_dispatches_total", "counter",
+     "calls dispatched through observed jit functions"),
+    ("v6t_jit_compiles_total", "counter",
+     "XLA lower+compile events recorded by the device observatory"),
+    ("v6t_jit_lower_seconds_total", "counter",
+     "seconds spent in jax lowering across observed compiles"),
+    ("v6t_jit_compile_seconds_total", "counter",
+     "seconds spent in XLA compilation across observed compiles"),
+    ("v6t_jit_retraces_total", "counter",
+     "retraces: an observed function compiled against a NEW abstract "
+     "signature (recompile_storm's series)"),
+    ("v6t_jit_fallbacks_total", "counter",
+     "observed dispatches that fell back to plain jax.jit (tracer args, "
+     "sharding mismatch, AOT-unloweable call)"),
+    ("v6t_jit_cache_evictions_total", "counter",
+     "compiled executables evicted from observed functions' bounded "
+     "signature caches"),
+    ("v6t_jit_functions", "gauge",
+     "functions registered with the device observatory"),
+    ("v6t_jit_signatures", "gauge",
+     "live compiled signatures across observed functions"),
+    ("v6t_jit_compile_temp_bytes", "gauge",
+     "temp bytes of the most recent observed compile (memory_analysis)"),
+    ("v6t_jit_compile_flops", "gauge",
+     "flops estimate of the most recent observed compile (cost_analysis)"),
+    # fingerprint-keyed runner caches (glm/quantile/device_engine via
+    # runtime.profiling.engine_cache_event)
+    ("v6t_engine_cache_hits_total", "counter",
+     "mesh.fingerprint()-keyed runner cache hits"),
+    ("v6t_engine_cache_misses_total", "counter",
+     "mesh.fingerprint()-keyed runner cache misses (fresh compiles)"),
+    ("v6t_engine_cache_entries", "gauge",
+     "live entries across the fingerprint-keyed runner caches"),
+    # per-device memory (runtime.profiling device_mem collector; absent
+    # on backends reporting no memory stats, e.g. CPU)
+    ("v6t_device_count", "gauge",
+     "local devices visible to this process"),
+    ("v6t_device_mem_bytes_in_use", "gauge",
+     "device memory in use, summed over local devices"),
+    ("v6t_device_mem_peak_bytes", "gauge",
+     "worst-device peak bytes in use across local devices"),
 ]
 
 _KNOWN: dict[str, tuple[str, str]] = {

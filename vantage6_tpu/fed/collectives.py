@@ -22,11 +22,27 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from vantage6_tpu.core.mesh import STATION_AXIS, station_shard_map
+from vantage6_tpu.runtime.profiling import RunnerCache, observed_jit
 
 if TYPE_CHECKING:  # pragma: no cover
     from vantage6_tpu.core.mesh import FederationMesh
 
 Pytree = Any
+
+# Eager-path runner cache for the shard_map'd reducers, keyed on
+# everything the closure bakes in (mesh fingerprint + the pad/dtype the
+# body hard-codes). A fresh closure per call would re-trace on EVERY
+# eager invocation — here the second same-shaped call reuses one observed
+# executable, and the device observatory (runtime.profiling) records each
+# compile as a device.compile span. Called inside an outer jit the
+# observed function inlines like a plain jitted one, unchanged.
+_SCATTER_RUNNERS = RunnerCache("collectives")
+
+
+def _scatter_runner(key: tuple, label: str, make):
+    return _SCATTER_RUNNERS.get_or_create(
+        key, lambda: observed_jit(label, make())
+    )
 
 
 def _station_count(stacked: Pytree) -> int:
@@ -275,11 +291,17 @@ def fed_sum_scattered(
         )
         return shard.astype(jnp.float32)
 
-    return station_shard_map(
-        mesh, body,
-        in_specs=(P(STATION_AXIS), P(STATION_AXIS)),
-        out_specs=P(STATION_AXIS),
-    )(stacked, w)
+    runner = _scatter_runner(
+        ("fed_sum_scattered", mesh.fingerprint(), str(comm_dtype),
+         n_flat, pad),
+        "collectives.fed_sum_scattered",
+        lambda: station_shard_map(
+            mesh, body,
+            in_specs=(P(STATION_AXIS), P(STATION_AXIS)),
+            out_specs=P(STATION_AXIS),
+        ),
+    )
+    return runner(stacked, w)
 
 
 def fed_mean_scattered(
@@ -314,9 +336,14 @@ def all_gather_stations(mesh: "FederationMesh", flat: jax.Array) -> jax.Array:
     def body(local: jax.Array) -> jax.Array:
         return jax.lax.all_gather(local, STATION_AXIS, tiled=True)
 
-    return station_shard_map(
-        mesh, body, in_specs=(P(STATION_AXIS),), out_specs=P(),
-    )(flat)
+    runner = _scatter_runner(
+        ("all_gather_stations", mesh.fingerprint()),
+        "collectives.all_gather",
+        lambda: station_shard_map(
+            mesh, body, in_specs=(P(STATION_AXIS),), out_specs=P(),
+        ),
+    )
+    return runner(flat)
 
 
 def fed_mean_scattered_tree(

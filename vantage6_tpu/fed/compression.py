@@ -53,6 +53,7 @@ import numpy as np
 
 from vantage6_tpu.common.serialization import SparseVector
 from vantage6_tpu.common.telemetry import REGISTRY
+from vantage6_tpu.runtime.profiling import observed_jit as _observed_jit
 
 Pytree = Any
 
@@ -279,12 +280,17 @@ def ef_norm(ef: jax.Array) -> jax.Array:
 
 
 # ----------------------------------------------------------- host-level API
-# jit caches keyed by (spec, shape) via jit's own cache — spec is a frozen
-# (hashable) dataclass, so marking it static is enough.
-_compress_jit = jax.jit(
-    compress_with_feedback, static_argnums=(0,), static_argnames=("cast_dtype",)
+# jit caches keyed by (spec, shape) — spec is a frozen (hashable)
+# dataclass, so marking it static is enough. Dispatch rides the device
+# observatory: a compress kernel recompiling per round (a wobbling delta
+# length) is a named retrace, not a mystery slowdown.
+_compress_jit = _observed_jit(
+    "compress.delta", compress_with_feedback,
+    static_argnums=(0,), static_argnames=("cast_dtype",),
 )
-_decompress_jit = jax.jit(decompress_flat, static_argnums=(0, 2))
+_decompress_jit = _observed_jit(
+    "compress.reconstruct", decompress_flat, static_argnums=(0, 2),
+)
 
 
 def _record_compress_telemetry(spec: CompressorSpec, n: int, count: int = 1):

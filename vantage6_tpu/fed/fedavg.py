@@ -44,6 +44,7 @@ from vantage6_tpu.fed.compression import (
     compress_stacked,
     record_round_telemetry,
 )
+from vantage6_tpu.runtime.profiling import observed_jit
 
 Pytree = Any
 # loss_fn(params, batch_x, batch_y, example_weights) -> scalar mean loss
@@ -94,17 +95,24 @@ class FedAvg:
         self.server_opt = spec.server_optimizer or optax.sgd(1.0)
         # NOTE: no buffer donation here — callers legitimately reuse params
         # across round() calls (e.g. ablations from one init); the scan in
-        # run_rounds already reuses buffers internally.
-        self._round = jax.jit(self._round_impl)
-        self._run = jax.jit(self._run_impl, static_argnames=("n_rounds",))
+        # run_rounds already reuses buffers internally. All three
+        # executables dispatch through the device observatory
+        # (runtime.profiling): every lowering/compile is a device.compile
+        # span + v6t_jit_* telemetry, and a shape-wobbling caller shows up
+        # as a named retrace instead of silent slow rounds.
+        self._round = observed_jit("fedavg.round", self._round_impl)
+        self._run = observed_jit(
+            "fedavg.run_rounds", self._run_impl,
+            static_argnames=("n_rounds",),
+        )
         # run_rounds IS the multi-round fast path: donating params,
         # opt_state and the key lets XLA update the scan carry in place
         # instead of double-buffering model + moments for the whole run.
         # Kept as a SEPARATE executable so run_rounds(donate=False) (and
         # AOT callers compiling self._run directly) never consume caller
         # buffers.
-        self._run_donating = jax.jit(
-            self._run_impl,
+        self._run_donating = observed_jit(
+            "fedavg.run_rounds_donating", self._run_impl,
             static_argnames=("n_rounds",),
             donate_argnums=(0, 1, 6),  # params, opt_state, key
         )

@@ -64,9 +64,17 @@ class MetricsLogger:
             round=round_index, seconds=dt,
             rounds_per_sec=1.0 / dt if dt > 0 else None,
         )
-        peak = device_peak_bytes()
-        if peak is not None:
-            fields["device_peak_bytes"] = peak
+        per = device_memory_all()
+        peaks = [d["peak_bytes"] for d in per if d.get("peak_bytes")]
+        if peaks:
+            # worst device first (the one that OOMs), the full census
+            # beside it — a skewed shard shows up as one hot device
+            fields["device_peak_bytes"] = max(peaks)
+            if len(per) > 1:
+                fields["per_device_peak_bytes"] = {
+                    str(d["id"]): d["peak_bytes"] for d in per
+                    if d.get("peak_bytes")
+                }
         self.log("round", **fields)
 
     def close(self) -> None:
@@ -201,20 +209,53 @@ def rest_stats_snapshot() -> dict[str, Any]:
     return REST_STATS.snapshot()
 
 
+def device_memory_all() -> list[dict[str, Any]]:
+    """Memory census of EVERY local device: ``{id, platform,
+    bytes_in_use, peak_bytes}`` per device, empty on backends that report
+    no memory stats (CPU). The one per-device hook `round_timer`, the
+    bench legs and the telemetry gauges (`v6t_device_mem_*`, registered
+    by `runtime.profiling`) share — a skewed shard or a single leaking
+    device is visible, not averaged away."""
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out: list[dict[str, Any]] = []
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use", in_use)
+        out.append({
+            "id": getattr(dev, "id", len(out)),
+            "platform": getattr(dev, "platform", "?"),
+            "bytes_in_use": int(in_use) if in_use is not None else None,
+            "peak_bytes": int(peak) if peak is not None else None,
+        })
+    return out
+
+
 def device_peak_bytes(device: Any = None) -> int | None:
     """Peak device-memory bytes from ``memory_stats()``, or None when the
-    backend doesn't report it (CPU). The ONE memory-observability hook the
-    bench `agg_modes` leg and production `round_timer` records share, so
-    their numbers are comparable."""
-    try:
-        dev = device if device is not None else jax.local_devices()[0]
-        stats = dev.memory_stats()
-    except Exception:
-        return None
-    if not stats:
-        return None
-    peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
-    return int(peak) if peak is not None else None
+    backend doesn't report it (CPU). With no ``device``, the WORST local
+    device's peak (the one that OOMs first) — generalized from the old
+    first-device-only probe; `device_memory_all` is the full census."""
+    if device is not None:
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            return None
+        if not stats:
+            return None
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        return int(peak) if peak is not None else None
+    peaks = [d["peak_bytes"] for d in device_memory_all()
+             if d.get("peak_bytes")]
+    return max(peaks) if peaks else None
 
 
 def _tolerant(obj: Any) -> Any:

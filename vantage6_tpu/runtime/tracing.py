@@ -619,6 +619,55 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
                 else None
             ),
         }
+    # device-plane call-out (docs/observability.md "device plane"): what
+    # the round paid BELOW jit — compiles (with the XLA memory/cost
+    # introspection the observatory stamps on each span), named retraces,
+    # and any profiler windows — read directly off the trace
+    device_plane = None
+    compile_spans = [s for s in spans if s.get("name") == "device.compile"]
+    profile_spans = [s for s in spans if s.get("name") == "device.profile"]
+    if compile_spans or profile_spans:
+        retraces = []
+        by_fn: dict[str, dict[str, Any]] = {}
+        peak_temp = 0
+        total_flops = 0.0
+        for sp in compile_spans:
+            attrs = sp.get("attrs") or {}
+            fn = str(attrs.get("function") or "?")
+            row = by_fn.setdefault(
+                fn, {"compiles": 0, "retraces": 0, "total_ms": 0.0}
+            )
+            row["compiles"] += 1
+            row["total_ms"] = round(
+                row["total_ms"] + sp.get("dur", 0.0) * 1e3, 3
+            )
+            if attrs.get("retrace"):
+                row["retraces"] += 1
+                retraces.append({
+                    "function": fn,
+                    "changed": attrs.get("changed"),
+                })
+            tb = attrs.get("temp_bytes")
+            if isinstance(tb, (int, float)):
+                peak_temp = max(peak_temp, int(tb))
+            fl = attrs.get("flops")
+            if isinstance(fl, (int, float)):
+                total_flops += float(fl)
+        device_plane = {
+            "n_compiles": len(compile_spans),
+            "n_retraces": len(retraces),
+            "compile_total_ms": round(
+                sum(s.get("dur", 0.0) for s in compile_spans) * 1e3, 3
+            ),
+            "peak_temp_bytes": peak_temp,
+            "total_flops": total_flops,
+            "by_function": by_fn,
+            "retraces": retraces,
+            "profile_windows": [
+                (s.get("attrs") or {}).get("log_dir")
+                for s in profile_spans
+            ],
+        }
     return {
         "n_spans": len(spans),
         "n_traces": len(traces),
@@ -626,6 +675,7 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "spans": table,
         "straggler": straggler,
         "compression": compression,
+        "device_plane": device_plane,
     }
 
 

@@ -299,6 +299,86 @@ def _check_ef_mass_growth(ctx: RuleContext) -> list[dict[str, Any]]:
     return []
 
 
+def _check_recompile_storm(ctx: RuleContext) -> list[dict[str, Any]]:
+    # a retrace or two is normal warm-up (new batch shape, first donated
+    # round); a STORM is the counter stepping every evaluation — the
+    # signature key is unstable and every dispatch recompiles
+    need = int(ctx.config["recompile_storm_retraces"])
+    window = int(ctx.config["recompile_storm_window"])
+    hist = ctx.history("v6t_jit_retraces_total")[-(window + 1):]
+    if len(hist) < 2:
+        return []
+    delta = hist[-1][1] - hist[0][1]
+    if delta < need:
+        return []
+    # name the culprit: the device-plane feed carries recent retrace
+    # events with the function and the leaf that changed. Scope to THIS
+    # window (the first in-window snapshot's timestamp) — the feed deque
+    # is all-time, and a warm-up burst hours ago must not out-vote the
+    # function actually storming now.
+    window_start = hist[0][0]
+    retraces = [
+        r for r in ctx.feed_items("retraces")
+        if not isinstance(r.get("ts"), (int, float))
+        or r["ts"] >= window_start
+    ]
+    by_fn: dict[str, int] = {}
+    last_changed: dict[str, str] = {}
+    for r in retraces:
+        fn = str(r.get("function") or "?")
+        by_fn[fn] = by_fn.get(fn, 0) + 1
+        if r.get("changed"):
+            last_changed[fn] = str(r["changed"])
+    if by_fn:
+        worst = max(by_fn, key=by_fn.get)
+        culprit = (
+            f"; worst offender {worst} ({by_fn[worst]} recent retraces"
+            + (f", last change {last_changed[worst]}" if worst in
+               last_changed else "")
+            + ")"
+        )
+        labels = {"function": worst}
+    else:
+        culprit = ""
+        labels = {}
+    return [{
+        "message": (
+            f"{delta:g} retrace(s) across the last {len(hist) - 1} "
+            f"evaluation(s) (threshold {need}): same function, new "
+            f"abstract signature — every one pays a full XLA "
+            f"compile{culprit}"
+        ),
+        "labels": labels,
+    }]
+
+
+def _check_device_mem_growth(ctx: RuleContext) -> list[dict[str, Any]]:
+    need = int(ctx.config["device_mem_growth_evals"])
+    min_pct = float(ctx.config["device_mem_growth_pct"])
+    hist = ctx.history("v6t_device_mem_bytes_in_use")[-(need + 1):]
+    if len(hist) < need + 1:
+        return []
+    values = [v for _, v in hist]
+    if values[0] <= 0:
+        return []
+    if not all(b > a for a, b in zip(values, values[1:])):
+        return []
+    growth_pct = 100.0 * (values[-1] - values[0]) / values[0]
+    if growth_pct < min_pct:
+        return []
+    return [{
+        "message": (
+            f"device memory in use grew {growth_pct:.1f}% over "
+            f"{need} consecutive evaluations "
+            f"({values[0]:.3g} -> {values[-1]:.3g} bytes): buffers are "
+            "accumulating instead of being freed (leaked executable "
+            "cache entry, un-donated carry, or host references pinning "
+            "device arrays)"
+        ),
+        "labels": {},
+    }]
+
+
 def default_rules() -> list[AlertRule]:
     return [
         AlertRule(
@@ -405,6 +485,42 @@ def default_rules() -> list[AlertRule]:
             metrics=("v6t_compress_ef_norm",),
             check=_check_ef_mass_growth,
         ),
+        AlertRule(
+            name="recompile_storm",
+            severity="warning",
+            summary=(
+                "Observed jit functions are retracing every evaluation — "
+                "an unstable abstract signature (wobbling batch shape, "
+                "fresh weak-typed scalar, new dtype) is paying a full "
+                "XLA compile per dispatch instead of reusing the cache."
+            ),
+            runbook=(
+                "the alert and the doctor perf digest name the function "
+                "and the leaf that changed; pad/bucket that input to a "
+                "static shape (or mark the wobbling scalar static). "
+                "trace_view's device call-out shows the compile cost."
+            ),
+            metrics=("v6t_jit_retraces_total",),
+            check=_check_recompile_storm,
+        ),
+        AlertRule(
+            name="device_mem_growth",
+            severity="warning",
+            summary=(
+                "Device memory in use is growing monotonically across "
+                "evaluations — buffers are accumulating instead of being "
+                "freed (leaked executable-cache entry, un-donated scan "
+                "carry, host references pinning device arrays)."
+            ),
+            runbook=(
+                "open a profile window (POST /api/debug/profile) around "
+                "a round and compare v6t_jit_signatures / "
+                "v6t_engine_cache_entries growth; clear or bound the "
+                "offending cache, or donate the round's carry buffers."
+            ),
+            metrics=("v6t_device_mem_bytes_in_use",),
+            check=_check_device_mem_growth,
+        ),
     ]
 
 
@@ -475,6 +591,10 @@ class Watchdog:
             "straggler_ratio": 3.0,
             "straggler_window": 8,
             "ef_growth_evals": 4,
+            "recompile_storm_retraces": 3,
+            "recompile_storm_window": 4,
+            "device_mem_growth_evals": 4,
+            "device_mem_growth_pct": 10.0,
         }
         self._history_len = max(8, history)
         self._feeds: dict[str, Callable[[], Any]] = {}  # guarded-by: _lock

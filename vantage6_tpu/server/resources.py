@@ -260,6 +260,37 @@ def register_resources(srv: "ServerApp") -> None:
             raise HTTPError(500, "flight dump failed (disk unwritable?)")
         return {"path": path, "counts": FLIGHT.stats()}, 201
 
+    @app.route("/api/debug/profile", methods=("POST",))
+    def debug_profile(req: Request):
+        """Open an on-demand jax.profiler window on THIS server process
+        (body: ``{"seconds": 1.0}``, clamped server-side) and return the
+        artifact path. The window is recorded as a ``device.profile``
+        span inside the requesting trace (the handler runs in the joined
+        request span) and registered in the flight recorder, so a later
+        doctor of a bundle names where the Perfetto session lives.
+        User-only like debug/dump — each call writes server disk and
+        holds a worker for the window; operators profile, stations
+        don't. 409 when a window is already open."""
+        _require_user(srv, req)
+        from vantage6_tpu.runtime.profiling import (
+            ProfileBusyError,
+            profile_window,
+        )
+
+        body = req.json
+        if body is None:
+            body = {}
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be a JSON object")
+        seconds = body.get("seconds", 1.0)
+        if isinstance(seconds, bool) or not isinstance(seconds, (int, float)):
+            raise HTTPError(400, "seconds must be a number")
+        try:
+            out = profile_window(float(seconds))
+        except ProfileBusyError as e:
+            raise HTTPError(409, str(e)) from None
+        return out, 201
+
     @app.route("/api/metrics")
     def metrics(req: Request):
         """Prometheus text exposition of the unified telemetry registry:

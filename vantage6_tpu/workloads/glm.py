@@ -34,6 +34,7 @@ import numpy as np
 from vantage6_tpu.algorithm.decorators import algorithm_client, data
 from vantage6_tpu.core.mesh import FederationMesh
 from vantage6_tpu.fed.collectives import fed_sum
+from vantage6_tpu.runtime.profiling import RunnerCache, observed_jit
 
 FAMILIES = ("gaussian", "binomial", "poisson")
 #: tiny ridge on X'WX: IRLS must not explode on separable/collinear data
@@ -202,7 +203,7 @@ def central_glm(
 
 
 # --------------------------------------------------------------- device mode
-_GLM_RUNNERS: dict[tuple, Any] = {}
+_GLM_RUNNERS = RunnerCache("glm")
 
 
 def _glm_runner(mesh: FederationMesh, family: str, n_iter: int):
@@ -212,38 +213,38 @@ def _glm_runner(mesh: FederationMesh, family: str, n_iter: int):
     callers constructing a FRESH FederationMesh over the same devices hit
     the cache too (object identity would recompile and leak an entry per
     call). Data enters as ARGUMENTS, not trace constants."""
-    key = (mesh.fingerprint(), family, n_iter)
-    cached = _GLM_RUNNERS.get(key)
-    if cached is not None:
-        return cached
 
-    def station_stats(x, y, m, beta):
-        eta = x @ beta
-        _, z, w, dev = _irls_pieces(family, eta, y, m)
-        # row mask rides the IRLS weight: padded rows contribute zero
-        xw = x * w[:, None]
-        return x.T @ xw, xw.T @ z, jnp.sum(dev)
+    def build():
+        def station_stats(x, y, m, beta):
+            eta = x @ beta
+            _, z, w, dev = _irls_pieces(family, eta, y, m)
+            # row mask rides the IRLS weight: padded rows contribute zero
+            xw = x * w[:, None]
+            return x.T @ xw, xw.T @ z, jnp.sum(dev)
 
-    def run(beta0, sx, sy, row_mask):
-        p = sx.shape[-1]
+        def run(beta0, sx, sy, row_mask):
+            p = sx.shape[-1]
 
-        def one_iter(beta, _):
-            xtwx, xtwz, dev = mesh.fed_map(
-                station_stats, sx, sy, row_mask, replicated_args=(beta,)
-            )
-            xtwx = fed_sum(xtwx)
-            xtwz = fed_sum(xtwz)
-            dev = fed_sum(dev)
-            new_beta = jnp.linalg.solve(
-                xtwx + _JITTER * jnp.eye(p, dtype=xtwx.dtype), xtwz
-            )
-            delta = jnp.max(jnp.abs(new_beta - beta))
-            return new_beta, (delta, dev)
+            def one_iter(beta, _):
+                xtwx, xtwz, dev = mesh.fed_map(
+                    station_stats, sx, sy, row_mask, replicated_args=(beta,)
+                )
+                xtwx = fed_sum(xtwx)
+                xtwz = fed_sum(xtwz)
+                dev = fed_sum(dev)
+                new_beta = jnp.linalg.solve(
+                    xtwx + _JITTER * jnp.eye(p, dtype=xtwx.dtype), xtwz
+                )
+                delta = jnp.max(jnp.abs(new_beta - beta))
+                return new_beta, (delta, dev)
 
-        return jax.lax.scan(one_iter, beta0, None, length=n_iter)
+            return jax.lax.scan(one_iter, beta0, None, length=n_iter)
 
-    _GLM_RUNNERS[key] = jax.jit(run)
-    return _GLM_RUNNERS[key]
+        return observed_jit(f"glm.irls.{family}", run)
+
+    return _GLM_RUNNERS.get_or_create(
+        (mesh.fingerprint(), family, n_iter), build
+    )
 
 
 def fit_glm_device(

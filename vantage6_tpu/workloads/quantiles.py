@@ -160,7 +160,9 @@ def central_quantile(
 
 
 # --------------------------------------------------------------- device mode
-_QUANTILE_RUNNERS: dict[tuple, Any] = {}
+# lazy RunnerCache: this module imports without jax (host mode);
+# runtime.profiling pulls jax in, so the cache is built on first device use
+_QUANTILE_RUNNERS: Any = None
 
 
 def _quantile_runner(mesh: Any, n_iter: int):
@@ -169,10 +171,12 @@ def _quantile_runner(mesh: Any, n_iter: int):
     executable instead of recompiling and leaking a cache entry. q and the
     bound sentinels enter as TRACED arguments, so one compilation serves
     every quantile of same-shaped data."""
-    key = (mesh.fingerprint(), n_iter)
-    cached = _QUANTILE_RUNNERS.get(key)
-    if cached is not None:
-        return cached
+    from vantage6_tpu.runtime.profiling import RunnerCache, observed_jit
+
+    global _QUANTILE_RUNNERS
+    if _QUANTILE_RUNNERS is None:
+        _QUANTILE_RUNNERS = RunnerCache("quantile")
+
     import jax
     import jax.numpy as jnp
 
@@ -220,8 +224,10 @@ def _quantile_runner(mesh: Any, n_iter: int):
         # bracket evidence for the host-side guards (cannot raise in jit)
         return bhi, n, count_below(lo), count_below(hi)
 
-    _QUANTILE_RUNNERS[key] = jax.jit(run)
-    return _QUANTILE_RUNNERS[key]
+    return _QUANTILE_RUNNERS.get_or_create(
+        (mesh.fingerprint(), n_iter),
+        lambda: observed_jit("quantile.bisection", run),
+    )
 
 
 def quantile_device(
