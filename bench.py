@@ -399,7 +399,8 @@ def worker_spmd() -> None:
     n_st = int(os.environ.get("BENCH_STATIONS", N_STATIONS))
     mesh = FederationMesh(n_st)
     engine = W.make_engine(
-        mesh, local_steps=LOCAL_STEPS, batch_size=BATCH, local_lr=LR
+        mesh, local_steps=LOCAL_STEPS, batch_size=BATCH, local_lr=LR,
+        learning_stats=False,  # pure-throughput leg: no discarded stats
     )
     sx, sy, counts = W.make_federated_data(
         n_st, n_per_station=N_PER_STATION, mesh=mesh,
@@ -423,7 +424,7 @@ def worker_spmd() -> None:
     def step(state, i):
         p, o = state
         for j in range(execs_per_run):
-            p, o, losses = compiled(
+            p, o, losses, _ = compiled(
                 p, o, sx, sy, counts, mask,
                 jax.random.fold_in(key, 100 + execs_per_run * i + j),
             )
@@ -434,7 +435,7 @@ def worker_spmd() -> None:
     # the timed chain's final params are (TIMED_RUNS + 1) * execs_per_run *
     # rounds deep into training; evaluate a FRESH acc-leg run from init
     # instead so both paths are compared at the same round count
-    p_acc, _, losses = compiled(
+    p_acc, _, losses, _ = compiled(
         params, opt_state, sx, sy, counts, mask, key
     )
     ex, ey = _eval_data()
@@ -711,7 +712,7 @@ def worker_agg() -> None:
     for name, kw in modes:
         eng = W.make_engine(
             mesh, local_steps=1, batch_size=8, local_lr=LR,
-            server_optimizer=optax.adam(1e-2), **kw,
+            server_optimizer=optax.adam(1e-2), learning_stats=False, **kw,
         )
         opt0 = eng.init(p0)
         args = (p0, opt0, sx, sy, counts, mask, key)
@@ -724,12 +725,13 @@ def worker_agg() -> None:
         t0 = time.perf_counter()
         compiled = eng._run.lower(*args, n_rounds=rounds).compile()
         compile_s = time.perf_counter() - t0
-        p1, o1, _ = compiled(*args)  # warm; o1 carries the PROGRAM's shardings
+        # warm; o1 carries the PROGRAM's shardings
+        p1, o1, _, _ = compiled(*args)
         jax.block_until_ready(o1)
 
         def step(state, i):
             p, o = state
-            p, o, losses = compiled(
+            p, o, losses, _ = compiled(
                 p, o, sx, sy, counts, mask, jax.random.fold_in(key, 100 + i)
             )
             return (p, o), losses
@@ -1030,13 +1032,20 @@ def worker_controlplane() -> None:
 def worker_observability() -> None:
     """observability leg: bare vs tracing vs full ops plane, alternated.
 
-    The guardrail for the tracing PR, extended by the watchdog PR: three
-    arms per rep — "off" (bare), "trace" (distributed tracing, the PR-5
-    configuration, so overhead_pct keeps its historical meaning), "ops"
-    (tracing + watchdog at an operator cadence + structured JSON logging
-    + flight taps). Arms alternate and compare best-of so a host-load
-    spike doesn't masquerade as instrumentation overhead;
-    ops_overhead_pct (ops vs trace) is the watchdog PR's <5% acceptance.
+    The guardrail for the tracing PR, extended by the watchdog, device-
+    observatory and learning-plane PRs: five arms per rep — "off"
+    (bare), "trace" (distributed tracing, the PR-5 configuration, so
+    overhead_pct keeps its historical meaning), "ops" (tracing +
+    watchdog at an operator cadence + structured JSON logging + flight
+    taps), "obsy" (ops + device observatory), "learn" (ops + learning
+    plane: per-task round recording + /api/rounds). Arms alternate and
+    compare best-of so a host-load spike doesn't masquerade as
+    instrumentation overhead; ops_overhead_pct (ops vs trace) is the
+    watchdog PR's <5% acceptance, learning_overhead_pct (learn vs ops)
+    the learning-plane PR's. The learning_anomaly smoke seeds a
+    label-flipped station in an engine run and asserts anomalous_station
+    names it within one watchdog interval, with fp32-identical stats
+    between replicated and scattered update paths.
     The traced arm also asserts the OBSERVABILITY acceptance: one task's
     trace covers client create → server dispatch → daemon claim → runner
     exec → result upload → aggregation, exports valid Perfetto
@@ -1057,6 +1066,7 @@ def worker_observability() -> None:
     from vantage6_tpu.common.enums import TaskStatus
     from vantage6_tpu.common.log import disable_json_sink, enable_json_sink
     from vantage6_tpu.node.daemon import NodeDaemon
+    from vantage6_tpu.runtime.learning import LEARNING, update_stats_host
     from vantage6_tpu.runtime.profiling import DEVICE_OBS
     from vantage6_tpu.runtime.tracing import (
         TRACER, summarize, to_trace_events,
@@ -1115,19 +1125,25 @@ def worker_observability() -> None:
         return srv, http, client, orgs, collab, daemons
 
     def arm(mode: str, arm_tag: str) -> dict:
-        # four alternated arms: "off" (no instrumentation), "trace"
+        # five alternated arms: "off" (no instrumentation), "trace"
         # (distributed tracing — the PR-5 configuration, so overhead_pct
         # keeps its historical meaning), "ops" (tracing + watchdog at an
         # operator cadence + JSON logging + flight taps — the full ops
         # plane; ops_overhead_pct vs the trace arm isolates what THIS
         # layer adds), "obsy" (ops + the device observatory armed —
         # observatory_overhead_pct vs the ops arm isolates the device-
-        # plane instrumentation, the observatory PR's <5% acceptance)
+        # plane instrumentation, the observatory PR's <5% acceptance),
+        # "learn" (ops + the learning plane armed: per-task round
+        # recording into LEARNING + the /api/rounds surface —
+        # learning_overhead_pct vs the ops arm isolates the learning-
+        # plane instrumentation, the learning-plane PR's <5% acceptance)
         tracing_on = mode != "off"
         TRACER.configure(enabled=tracing_on, sample=1.0)
         TRACER.clear()
         DEVICE_OBS.configure(enabled=mode == "obsy")
-        if mode in ("ops", "obsy"):
+        if mode == "learn":
+            LEARNING.clear()
+        if mode in ("ops", "obsy", "learn"):
             WATCHDOG.configure(interval=OBS_WD_ARM_INTERVAL)
             enable_json_sink(os.path.join(tmp, f"log-{arm_tag}.jsonl"))
         else:
@@ -1139,6 +1155,7 @@ def worker_observability() -> None:
         org_ids = [o["id"] for o in orgs]
         parity = True
         last_trace = None
+        last_learn_task = None
         t_all0 = time.perf_counter()
         for i in range(n_tasks):
             targets = [org_ids[(i + k) % n_daemons] for k in range(2)]
@@ -1160,6 +1177,18 @@ def worker_observability() -> None:
                 total = sum(r["sum"] for r in res)
                 count = sum(r["count"] for r in res)
                 parity &= count == 64 and total > 0
+                if mode == "learn":
+                    # learning plane armed: the per-station result
+                    # vectors are this round's "updates" — stats + a
+                    # RoundHistory record per task (the learning.round
+                    # span joins the ambient aggregate span)
+                    flat = np.array(
+                        [[r["sum"], r["count"]] for r in res], np.float32
+                    )
+                    LEARNING.history(t["id"]).record_stats(
+                        update_stats_host(flat)
+                    )
+                    last_learn_task = t["id"]
             runs = client.run.from_task(t["id"])
             parity &= sorted(
                 r["organization"]["id"] for r in runs
@@ -1175,6 +1204,19 @@ def worker_observability() -> None:
             "tasks_per_sec": round(n_tasks / total_s, 3),
             "parity_ok": bool(parity),
         }
+        if mode == "learn" and last_learn_task is not None:
+            # outside the timed window: the /api/rounds surface serves
+            # what the arm recorded (route + registry acceptance)
+            rr = client.util.rounds(last_learn_task)
+            idx = client.util.rounds()
+            out["rounds_endpoint_ok"] = (
+                rr.get("task_id") == last_learn_task
+                and len(rr.get("rounds") or []) >= 1
+            )
+            out["rounds_index_ok"] = any(
+                t2.get("task") == last_learn_task
+                for t2 in idx.get("tasks") or []
+            )
         if tracing_on and last_trace is not None:
             spans = TRACER.drain(last_trace)
             names = {s["name"] for s in spans}
@@ -1452,8 +1494,137 @@ def worker_observability() -> None:
             WATCHDOG.stop()
         return out
 
+    def learning_anomaly_smoke() -> dict:
+        """Seed an anomalous station — label-flipped data on 1 of 8
+        stations of a FedAvg engine run, so its local updates point
+        AGAINST the pooled delta — and prove the learning plane NAMES it:
+        the `anomalous_station` alert (within one watchdog interval of
+        the rounds being recorded, message carrying the station and the
+        offending stat) and the doctor learning digest of a flight dump.
+        Also asserts the in-round stats are fp32-IDENTICAL between the
+        replicated and scattered (ZeRO-1) update paths."""
+        import subprocess
+
+        import jax
+        import jax.numpy as jnp
+
+        from vantage6_tpu.common.flight import FLIGHT
+        from vantage6_tpu.core.mesh import FederationMesh
+        from vantage6_tpu.fed.fedavg import FedAvg, FedAvgSpec
+
+        TRACER.configure(enabled=True, sample=1.0)
+        WATCHDOG.configure(interval=OBS_WD_INTERVAL)
+        LEARNING.clear()
+        FLIGHT.clear()
+        S, n_rows, d = 8, 32, 16
+        seeded = 5
+        rng2 = np.random.default_rng(7)
+        x = rng2.standard_normal((S, n_rows, d)).astype(np.float32)
+        beta = rng2.standard_normal(d).astype(np.float32)
+        y = (x @ beta + 0.05 * rng2.standard_normal(
+            (S, n_rows)
+        )).astype(np.float32)
+        y[seeded] = -y[seeded]  # the label flip
+
+        def loss_fn(p, bx, by, w):
+            pred = bx @ p
+            return jnp.sum(w * (pred - by) ** 2) / jnp.maximum(
+                jnp.sum(w), 1.0
+            )
+
+        mesh = FederationMesh(S)
+        kw = dict(
+            loss_fn=loss_fn, local_steps=2, batch_size=16, local_lr=0.02
+        )
+        counts = jnp.full((S,), float(n_rows))
+        p0 = jnp.zeros(d)
+        key = jax.random.key(3)
+        rounds = 6
+        rep_eng = FedAvg(mesh, FedAvgSpec(**kw))
+        scat_eng = FedAvg(mesh, FedAvgSpec(**kw, shard_server_update=True))
+        _, _, losses_rep, stats_rep = rep_eng.run_rounds(
+            p0, jnp.asarray(x), jnp.asarray(y), counts, key, rounds,
+            donate=False,
+        )
+        _, _, _, stats_scat = scat_eng.run_rounds(
+            p0, jnp.asarray(x), jnp.asarray(y), counts, key, rounds,
+            donate=False,
+        )
+        fp32_identical = all(
+            np.array_equal(
+                np.asarray(stats_rep[k]), np.asarray(stats_scat[k])
+            )
+            for k in stats_rep
+        )
+        WATCHDOG.start()
+        out: dict = {}
+        try:
+            quiet_before = not any(
+                a["rule"] == "anomalous_station"
+                for a in WATCHDOG.evaluate()
+            )
+            history = LEARNING.history("bench-anomaly")
+            with TRACER.span("bench.learning_anomaly", kind="bench"):
+                history.record_engine(losses_rep, stats_rep)
+            recorded_at = time.monotonic()
+            deadline = recorded_at + 4 * OBS_WD_INTERVAL + 2.0
+            alert = None
+            while time.monotonic() < deadline and alert is None:
+                alert = next(
+                    (a for a in WATCHDOG.active_alerts()
+                     if a["rule"] == "anomalous_station"), None,
+                )
+                if alert is None:
+                    time.sleep(0.05)
+            detect_s = time.monotonic() - recorded_at
+            budget_s = 2 * OBS_WD_INTERVAL + 0.5  # 1 interval + poll slack
+            dump_path = FLIGHT.dump(reason="bench-anomaly")
+            doctor = subprocess.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "doctor.py",
+                ), dump_path],
+                capture_output=True, text=True, timeout=60,
+            )
+            seeded_cos = float(
+                np.asarray(stats_rep["station_cos"])[-1][seeded]
+            )
+            out = {
+                "quiet_before": quiet_before,
+                "seeded_station": seeded,
+                "rounds_recorded": rounds,
+                "fp32_identical": bool(fp32_identical),
+                "seeded_station_cos_last_round": round(seeded_cos, 4),
+                "alert_raised": alert is not None,
+                "alert_names_station": bool(
+                    alert
+                    and alert["labels"].get("station") == seeded
+                    and f"station {seeded}" in alert["message"]
+                ),
+                "alert_names_stat": bool(
+                    alert and (
+                        "cosine" in alert["message"]
+                        or "norm" in alert["message"]
+                    )
+                ),
+                "alert_message": alert["message"] if alert else None,
+                "anomaly_detect_s": round(detect_s, 2),
+                "detect_budget_s": round(budget_s, 2),
+                "within_one_interval": alert is not None
+                and detect_s <= budget_s,
+                "flight_bundle": dump_path,
+                "doctor_names_station": (
+                    doctor.returncode == 0
+                    and "anomalous_station" in doctor.stdout
+                    and f"station {seeded}" in doctor.stdout
+                ),
+            }
+        finally:
+            WATCHDOG.stop()
+        return out
+
     try:
-        offs, ons, opss, obsys = [], [], [], []
+        offs, ons, opss, obsys, learns = [], [], [], [], []
         traced: dict = {}
         for rep in range(max(1, int(os.environ.get(
             "BENCH_OBS_REPS", str(OBS_REPS)
@@ -1464,8 +1635,10 @@ def worker_observability() -> None:
             ons.append(on)
             opss.append(arm("ops", f"ops{rep}"))
             obsys.append(arm("obsy", f"obsy{rep}"))
+            learns.append(arm("learn", f"learn{rep}"))
         watchdog_smoke = fault_smoke()
         storm_smoke = retrace_storm_smoke()
+        anomaly_smoke = learning_anomaly_smoke()
     finally:
         TRACER.configure(enabled=True, sample=1.0)
         disable_json_sink()
@@ -1477,14 +1650,20 @@ def worker_observability() -> None:
     best_on = max(a["tasks_per_sec"] for a in ons)
     best_ops = max(a["tasks_per_sec"] for a in opss)
     best_obsy = max(a["tasks_per_sec"] for a in obsys)
+    best_learn = max(a["tasks_per_sec"] for a in learns)
     overhead_pct = round(100.0 * (best_off - best_on) / best_off, 2)
     # what the WATCHDOG PR adds on top of tracing (the "<5% watchdog +
     # JSON logging" acceptance): ops arm vs trace arm, best-of each
     ops_overhead_pct = round(100.0 * (best_on - best_ops) / best_on, 2)
     # what the DEVICE OBSERVATORY adds on top of the full ops plane
-    # (this PR's <5% acceptance): observatory arm vs ops arm, best-of
+    # (the observatory PR's <5% acceptance): observatory arm vs ops arm
     observatory_overhead_pct = round(
         100.0 * (best_ops - best_obsy) / best_ops, 2
+    )
+    # what the LEARNING PLANE adds on top of the full ops plane (this
+    # PR's <5% acceptance): learn arm vs ops arm, best-of each
+    learning_overhead_pct = round(
+        100.0 * (best_ops - best_learn) / best_ops, 2
     )
     print(json.dumps({
         "n_daemons": n_daemons,
@@ -1498,16 +1677,26 @@ def worker_observability() -> None:
         "overhead_ok": overhead_pct < OBS_OVERHEAD_PCT,
         "ops_overhead_pct": ops_overhead_pct,
         "ops_overhead_ok": ops_overhead_pct < OBS_OVERHEAD_PCT,
+        "tasks_per_sec_learning_plane": best_learn,
         "observatory_overhead_pct": observatory_overhead_pct,
         "observatory_overhead_ok": (
             observatory_overhead_pct < OBS_OVERHEAD_PCT
         ),
+        "learning_overhead_pct": learning_overhead_pct,
+        "learning_overhead_ok": learning_overhead_pct < OBS_OVERHEAD_PCT,
         "overhead_budget_pct": OBS_OVERHEAD_PCT,
         "ops_plane_in_ops_arm": ["tracing", "watchdog", "json_logging",
                                  "flight_taps"],
         "observatory_in_obsy_arm": ["ops_plane", "device_observatory"],
+        "learning_plane_in_learn_arm": [
+            "ops_plane", "round_recording", "rounds_api",
+        ],
+        "rounds_endpoint_ok": all(
+            a.get("rounds_endpoint_ok") and a.get("rounds_index_ok")
+            for a in learns
+        ),
         "parity_ok": all(
-            a["parity_ok"] for a in offs + ons + opss + obsys
+            a["parity_ok"] for a in offs + ons + opss + obsys + learns
         ),
         "trace": {
             k: traced.get(k)
@@ -1518,6 +1707,7 @@ def worker_observability() -> None:
         },
         "watchdog": watchdog_smoke,
         "retrace_storm": storm_smoke,
+        "learning_anomaly": anomaly_smoke,
     }))
 
 
@@ -1769,19 +1959,19 @@ def worker_compression() -> None:
     for name, compressor in (("dense", None), ("compressed", spec)):
         eng = W.make_engine(
             mesh, local_steps=local_steps, batch_size=batch, local_lr=LR,
-            compressor=compressor,
+            compressor=compressor, learning_stats=False,
         )
         opt0 = eng.init(p0)
         args = (p0, opt0, sx, sy, counts, mask, key)
         t0 = time.perf_counter()
         compiled = eng._run.lower(*args, n_rounds=rounds).compile()
         compile_s = time.perf_counter() - t0
-        p1, o1, losses = compiled(*args)  # warm (deterministic on args)
+        p1, o1, losses, _ = compiled(*args)  # warm (deterministic on args)
         jax.block_until_ready(losses)
 
         def step(state, i):
             p, o = state
-            p, o, ls = compiled(
+            p, o, ls, _ = compiled(
                 p, o, sx, sy, counts, mask, jax.random.fold_in(key, 50 + i)
             )
             return (p, o), ls

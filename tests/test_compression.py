@@ -462,8 +462,8 @@ class TestFedAvgCompressed:
         lossless = tiny_fed["engine"](
             compressor=CompressorSpec(topk_ratio=1.0)
         )
-        pd_, _, ld = self._run(tiny_fed, dense)
-        pc_, oc, lc = self._run(tiny_fed, lossless)
+        pd_, _, ld, _ = self._run(tiny_fed, dense)
+        pc_, oc, lc, _ = self._run(tiny_fed, lossless)
         for a, b in zip(jax.tree.leaves(pd_), jax.tree.leaves(pc_)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         np.testing.assert_array_equal(np.asarray(ld), np.asarray(lc))
@@ -472,7 +472,7 @@ class TestFedAvgCompressed:
     def test_lossy_compressed_run_converges(self, tiny_fed):
         spec = CompressorSpec(topk_ratio=0.25, int8=True, chunk=8)
         eng = tiny_fed["engine"](compressor=spec)
-        params, state, losses = self._run(tiny_fed, eng, rounds=8)
+        params, state, losses, _ = self._run(tiny_fed, eng, rounds=8)
         losses = np.asarray(losses)
         assert losses[-1] < losses[0] * 0.5  # actually learning
         ef = np.asarray(state["ef"])
@@ -486,8 +486,8 @@ class TestFedAvgCompressed:
         lossy = tiny_fed["engine"](
             compressor=CompressorSpec(topk_ratio=0.25, int8=True, chunk=8)
         )
-        _, _, ld = self._run(tiny_fed, dense, rounds=8)
-        _, _, lc = self._run(tiny_fed, lossy, rounds=8)
+        _, _, ld, _ = self._run(tiny_fed, dense, rounds=8)
+        _, _, lc, _ = self._run(tiny_fed, lossy, rounds=8)
         assert float(lc[-1]) < float(ld[-1]) * 2.0 + 0.05
 
     def test_round_and_run_rounds_state_compatible(self, tiny_fed):
@@ -495,13 +495,13 @@ class TestFedAvgCompressed:
         eng = tiny_fed["engine"](compressor=spec)
         state = eng.init(tiny_fed["p0"])
         assert set(state) == {"server", "ef"}
-        p1, state1, _ = eng.round(
+        p1, state1, _, _ = eng.round(
             tiny_fed["p0"], state, tiny_fed["sx"], tiny_fed["sy"],
             tiny_fed["counts"], jax.random.key(1),
         )
         # resuming run_rounds from a round()'s state must work (the carry
         # is the same pytree shape)
-        p2, state2, _ = eng.run_rounds(
+        p2, state2, _, _ = eng.run_rounds(
             p1, tiny_fed["sx"], tiny_fed["sy"], tiny_fed["counts"],
             jax.random.key(2), n_rounds=2, opt_state=state1, donate=False,
         )
@@ -516,7 +516,7 @@ class TestFedAvgCompressed:
             comm_dtype=jnp.bfloat16,
             server_optimizer=optax.adam(1e-2),
         )
-        params, state, losses = self._run(tiny_fed, eng, rounds=4)
+        params, state, losses, _ = self._run(tiny_fed, eng, rounds=4)
         assert np.isfinite(np.asarray(losses)).all()
         assert np.isfinite(np.asarray(state["ef"])).all()
 
@@ -524,7 +524,7 @@ class TestFedAvgCompressed:
         spec = CompressorSpec(topk_ratio=0.5)
         eng = tiny_fed["engine"](compressor=spec)
         mask = jnp.asarray([1, 1, 0, 1, 1, 1, 1, 1], jnp.float32)
-        params, _, losses = eng.run_rounds(
+        params, _, losses, _ = eng.run_rounds(
             tiny_fed["p0"], tiny_fed["sx"], tiny_fed["sy"],
             tiny_fed["counts"], jax.random.key(0), n_rounds=2, mask=mask,
             donate=False,
@@ -542,14 +542,14 @@ class TestFedAvgCompressed:
         state = eng.init(tiny_fed["p0"])
         mask = jnp.asarray([1, 1, 1, 0, 1, 1, 1, 1], jnp.float32)
         # round 1 with everyone in: every EF row becomes nonzero
-        _, state, _ = eng.round(
+        _, state, _, _ = eng.round(
             tiny_fed["p0"], state, tiny_fed["sx"], tiny_fed["sy"],
             tiny_fed["counts"], jax.random.key(1),
         )
         ef1 = np.asarray(state["ef"])
         assert np.abs(ef1).sum() > 0
         # round 2 with station 3 masked out: its row is bit-identical
-        _, state, _ = eng.round(
+        _, state, _, _ = eng.round(
             tiny_fed["p0"], state, tiny_fed["sx"], tiny_fed["sy"],
             tiny_fed["counts"], jax.random.key(2), mask=mask,
         )

@@ -177,7 +177,7 @@ _CHILD_FEDAVG = textwrap.dedent(
 
     params = W.init_params(jax.random.key(0))
     opt = engine.init(params)
-    params, opt, loss = engine.round(
+    params, opt, loss, _ = engine.round(
         params, opt, sx, sy, counts, jax.random.key(1)
     )
     jax.block_until_ready(params)

@@ -27,7 +27,7 @@ def test_loss_decreases_and_learns(mesh, small_engine, fed_data):
     sx, sy, counts = fed_data
     key = jax.random.key(0)
     params = W.init_params(jax.random.fold_in(key, 1))
-    params, _, losses = small_engine.run_rounds(
+    params, _, losses, _stats = small_engine.run_rounds(
         params, sx, sy, counts, jax.random.fold_in(key, 2), 10
     )
     losses = np.asarray(losses)

@@ -25,7 +25,7 @@ def test_fedavg_round_is_device_resident(devices):
     key = jax.device_put(key, mesh.replicated_sharding())
     mask = jax.device_put(mask, mesh.replicated_sharding())
     with no_implicit_transfers():
-        p, o, loss = engine.round(params, opt_state, sx, sy, counts, key,
+        p, o, loss, _ = engine.round(params, opt_state, sx, sy, counts, key,
                                   mask=mask)
         jax.block_until_ready(p)
     assert np.isfinite(float(loss))
@@ -45,7 +45,7 @@ def test_guard_catches_host_operand(devices):
     host_counts = np.asarray(counts)  # the leak: counts fell off the mesh
     with pytest.raises(Exception, match="[Tt]ransfer"):
         with no_implicit_transfers():
-            p, _, _ = engine.round(
+            p, _, _, _ = engine.round(
                 params, opt_state, sx, sy, host_counts, key
             )
             jax.block_until_ready(p)

@@ -133,10 +133,10 @@ def test_sharded_server_update_parity_5_rounds(mesh, fed_data, server_opt):
     kw = dict(local_steps=2, batch_size=16, server_optimizer=server_opt)
     e_rep = W.make_engine(mesh, **kw)
     e_shard = W.make_engine(mesh, shard_server_update=True, **kw)
-    p_rep, _, l_rep = e_rep.run_rounds(
+    p_rep, _, l_rep, _ = e_rep.run_rounds(
         p0, sx, sy, counts, key, 5, mask=mask, donate=False
     )
-    p_shard, _, l_shard = e_shard.run_rounds(
+    p_shard, _, l_shard, _ = e_shard.run_rounds(
         p0, sx, sy, counts, key, 5, mask=mask, donate=False
     )
     _assert_trees_close(p_rep, p_shard, atol=1e-5)
@@ -174,10 +174,10 @@ def test_bf16_comm_close_to_fp32(mesh, fed_data):
     key = jax.random.key(5)
     p0 = W.init_params(jax.random.fold_in(key, 1))
     kw = dict(local_steps=2, batch_size=16)
-    p_rep, _, _ = W.make_engine(mesh, **kw).run_rounds(
+    p_rep, _, _, _ = W.make_engine(mesh, **kw).run_rounds(
         p0, sx, sy, counts, key, 5, donate=False
     )
-    p_bf, _, _ = W.make_engine(
+    p_bf, _, _, _ = W.make_engine(
         mesh, shard_server_update=True, comm_dtype=jnp.bfloat16, **kw
     ).run_rounds(p0, sx, sy, counts, key, 5, donate=False)
     # bf16 wire keeps ~2-3 decimal digits; the drift bound documents the
@@ -247,8 +247,8 @@ def test_run_rounds_default_donates_and_returns_fresh(mesh, fed_data):
     key = jax.random.key(17)
     p0 = W.init_params(key)
     eng = W.make_engine(mesh, local_steps=1, batch_size=8)
-    p1, o1, _ = eng.run_rounds(p0, sx, sy, counts, jax.random.key(1), 2)
-    p2, _, losses = eng.run_rounds(
+    p1, o1, _, _ = eng.run_rounds(p0, sx, sy, counts, jax.random.key(1), 2)
+    p2, _, losses, _ = eng.run_rounds(
         p1, sx, sy, counts, jax.random.key(2), 2, opt_state=o1
     )
     assert np.isfinite(np.asarray(losses)).all()

@@ -653,6 +653,12 @@ class TestTornTailUnderConcurrentWriter:
 def srv():
     TRACER.configure(enabled=True, sample=1.0, sink=None)
     TRACER.clear()
+    # the singleton watchdog reads the process-global learning registry
+    # (registered feed): histories recorded by EARLIER test modules'
+    # aggregations must not leak alerts into this server's verdict
+    from vantage6_tpu.runtime.learning import LEARNING
+
+    LEARNING.clear()
     app = ServerApp()
     app.ensure_root(password="rootpass123")
     yield app

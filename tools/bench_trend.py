@@ -60,6 +60,12 @@ HEADLINES: list[tuple[str, str, str]] = [
     ("overhead_pct", "lower", "observability"),
     ("ops_overhead_pct", "lower", "observability"),
     ("observatory_overhead_pct", "lower", "observability"),
+    # learning plane (per-station update telemetry PR): what arming the
+    # learning recording adds on top of the ops arm, and how fast a
+    # seeded anomalous station is named (both can ride host noise; the
+    # non-positive-baseline skip applies the same as the other overheads)
+    ("learning_overhead_pct", "lower", "observability"),
+    ("anomaly_detect_s", "lower", "observability"),
     ("wire_reduction_ratio", "higher", "compression"),
 ]
 

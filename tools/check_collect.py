@@ -87,6 +87,9 @@ _ROUTE_AUDIT: dict[str, list[str]] = {
     # device observatory (docs/observability.md "device plane"): the
     # on-demand jax.profiler window the client util opens
     "debug/profile": ["vantage6_tpu/client/client.py"],
+    # learning plane (docs/observability.md "learning plane"): per-task
+    # round histories the client util reads (index + per-task routes)
+    "rounds": ["vantage6_tpu/client/client.py"],
 }
 
 
@@ -239,6 +242,70 @@ def check_device_observatory() -> list[str]:
             "the /api/debug/profile route is missing from the route-audit "
             "map (_ROUTE_AUDIT) — the endpoint/call-site agreement check "
             "no longer covers the profile window"
+        )
+    return problems
+
+
+def check_learning_plane() -> list[str]:
+    """Audit the learning-plane surface (runtime/learning.py,
+    docs/observability.md "learning plane"):
+
+    - every ``v6t_round_*`` / ``v6t_station_*`` metric declared in
+      KNOWN_METRICS is actually emitted by runtime/learning.py (string
+      literal), and every such literal learning.py emits is declared —
+      the same both-direction drift gate the device observatory has;
+    - the three learning alert rules (``anomalous_station``,
+      ``model_divergence``, ``non_convergence``) exist in the watchdog's
+      DEFAULT_RULES/RULE_CATALOG — deleting or renaming one silently
+      blinds the plane;
+    - the ``/api/rounds`` route is in the route-audit map above, so the
+      endpoint/call-site agreement check covers it.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import KNOWN_METRICS
+        from vantage6_tpu.runtime.watchdog import RULE_CATALOG
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import the learning-plane surface: {e!r}"]
+    path = os.path.join(
+        _REPO_ROOT, "vantage6_tpu", "runtime", "learning.py"
+    )
+    try:
+        source = open(path).read()
+    except OSError as e:
+        return [f"cannot read runtime/learning.py: {e}"]
+    prefixes = ("v6t_round_", "v6t_station_")
+    declared = {
+        name for name, _kind, _help in KNOWN_METRICS
+        if name.startswith(prefixes)
+    }
+    emitted = set(re.findall(
+        r'"(v6t_(?:round|station)_[a-z0-9_]*)"', source
+    ))
+    for name in sorted(declared - emitted):
+        problems.append(
+            f"metric {name!r} declared in KNOWN_METRICS but never emitted "
+            "by runtime/learning.py"
+        )
+    for name in sorted(emitted - declared):
+        problems.append(
+            f"runtime/learning.py emits {name!r} which is not declared "
+            "in KNOWN_METRICS (common/telemetry.py)"
+        )
+    for rule in ("anomalous_station", "model_divergence", "non_convergence"):
+        if rule not in RULE_CATALOG:
+            problems.append(
+                f"learning alert rule {rule!r} is missing from the "
+                "watchdog rule table (runtime/watchdog.py) — the learning "
+                "plane records stats nothing watches"
+            )
+    if "rounds" not in _ROUTE_AUDIT:
+        problems.append(
+            "the /api/rounds route is missing from the route-audit map "
+            "(_ROUTE_AUDIT) — the endpoint/call-site agreement check no "
+            "longer covers the learning plane"
         )
     return problems
 
@@ -462,6 +529,17 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    learning_problems = check_learning_plane()
+    if learning_problems:
+        sys.stderr.write(
+            "LEARNING PLANE DRIFT: the declared v6t_round_*/v6t_station_* "
+            "surface, the learning alert rules, or the /api/rounds route "
+            "audit drifted (docs/observability.md):\n"
+        )
+        for p in learning_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     note_bench_trend()
 
     lint_problems = check_static_analysis()
@@ -517,6 +595,8 @@ def main(argv: list[str]) -> int:
               "reading only declared metrics")
         print("device-observatory audit ok: v6t_jit_*/v6t_engine_cache_* "
               "declared <-> emitted, profile route audited")
+        print("learning-plane audit ok: v6t_round_*/v6t_station_* declared "
+              "<-> emitted, rules cataloged, rounds route audited")
         print("static analysis ok: v6lint found no unwaived violations")
         print(f"collection clean: {counted} tests collected")
         return 0

@@ -243,6 +243,134 @@ def render_perf(perf: dict[str, Any]) -> list[str]:
     return lines
 
 
+def learning_digest(
+    records: list[dict[str, Any]],
+    alerts: list[dict[str, Any]] | None = None,
+) -> dict[str, Any] | None:
+    """Learning-plane view of the bundle (docs/observability.md
+    "learning plane"): per-task convergence trajectory (pooled update
+    norm first→last across `learning_round` notes, anchored on the
+    final-state `learning` summary records when the note ring evicted
+    early rounds), a per-station contribution table (mean norm / mean
+    cos / min cos), and the stations the anomalous_station alerts named.
+    None when the bundle predates the learning plane."""
+    notes = [
+        r for r in records
+        if r.get("type") == "note" and r.get("kind") == "learning_round"
+    ]
+    finals = [r for r in records if r.get("type") == "learning"]
+    if not notes and not finals:
+        return None
+    tasks: dict[str, dict[str, Any]] = {}
+    for r in sorted(notes, key=lambda r: (r.get("round") or 0)):
+        task = str(r.get("task"))
+        t = tasks.setdefault(task, {
+            "task": r.get("task"), "rounds_seen": 0, "norms": [],
+            "losses": [], "stations": {},
+        })
+        t["rounds_seen"] += 1
+        if isinstance(r.get("update_norm"), (int, float)):
+            t["norms"].append(r["update_norm"])
+        if isinstance(r.get("loss"), (int, float)):
+            t["losses"].append(r["loss"])
+        norms = r.get("station_norms") or []
+        cosines = r.get("station_cos") or []
+        for s in range(len(norms)):
+            st = t["stations"].setdefault(s, {"norms": [], "cos": []})
+            st["norms"].append(norms[s])
+            if s < len(cosines):
+                st["cos"].append(cosines[s])
+    out_tasks = []
+    for t in tasks.values():
+        norms = t["norms"]
+        row: dict[str, Any] = {
+            "task": t["task"],
+            "rounds_seen": t["rounds_seen"],
+            "first_update_norm": norms[0] if norms else None,
+            "last_update_norm": norms[-1] if norms else None,
+            "norm_decay_pct": (
+                round(100.0 * (1.0 - norms[-1] / norms[0]), 2)
+                if len(norms) > 1 and norms[0] else None
+            ),
+            "last_loss": t["losses"][-1] if t["losses"] else None,
+            "stations": [
+                {
+                    "station": s,
+                    "mean_norm": sum(st["norms"]) / len(st["norms"]),
+                    "mean_cos": (
+                        sum(st["cos"]) / len(st["cos"]) if st["cos"] else None
+                    ),
+                    "min_cos": min(st["cos"]) if st["cos"] else None,
+                }
+                for s, st in sorted(t["stations"].items())
+            ],
+        }
+        out_tasks.append(row)
+    # final-state summaries cover tasks whose per-round notes were evicted
+    seen = {str(t["task"]) for t in out_tasks}
+    for f in finals:
+        if str(f.get("task")) in seen:
+            continue
+        out_tasks.append({
+            "task": f.get("task"),
+            "rounds_seen": 0,
+            "rounds_total": f.get("rounds"),
+            "first_update_norm": f.get("first_update_norm"),
+            "last_update_norm": f.get("last_update_norm"),
+            "norm_decay_pct": f.get("decay_pct"),
+            "last_loss": f.get("last_loss"),
+            "stations": f.get("stations") or [],
+        })
+    anomalous = [
+        {"rule": a["rule"], "labels": a.get("labels") or {},
+         "message": a.get("message")}
+        for a in (alerts or [])
+        if a.get("rule") in
+        ("anomalous_station", "model_divergence", "non_convergence")
+    ]
+    return {"tasks": out_tasks, "alerts": anomalous}
+
+
+def render_learning(learning: dict[str, Any]) -> list[str]:
+    lines = ["\nlearning-plane digest:"]
+    for a in learning.get("alerts") or []:
+        labels = a["labels"]
+        who = (
+            f"station {labels['station']}"
+            if "station" in labels else f"task {labels.get('task')}"
+        )
+        lines.append(f"  [{a['rule']}] {who}: {a.get('message')}")
+    for t in learning.get("tasks") or []:
+        first, last = t.get("first_update_norm"), t.get("last_update_norm")
+        traj = ""
+        if first is not None and last is not None:
+            traj = f": update norm {first:.4g} -> {last:.4g}"
+            if t.get("norm_decay_pct") is not None:
+                traj += f" ({t['norm_decay_pct']:+.1f}% decay)"
+        lines.append(
+            f"  task {t['task']} "
+            f"({t.get('rounds_seen') or t.get('rounds_total') or 0} "
+            f"round(s)){traj}"
+            + (f", last loss {t['last_loss']:.4g}"
+               if t.get("last_loss") is not None else "")
+        )
+        stations = t.get("stations") or []
+        if stations:
+            lines.append(
+                "    station   mean norm    mean cos     min cos"
+            )
+            for st in stations:
+                def _fmt(v):
+                    return f"{v:>10.4g}" if isinstance(
+                        v, (int, float)
+                    ) else f"{'—':>10}"
+                lines.append(
+                    f"    {st.get('station'):>7} {_fmt(st.get('mean_norm'))}"
+                    f"  {_fmt(st.get('mean_cos'))}  {_fmt(st.get('min_cos'))}"
+                )
+    return lines
+
+
 def timeline(
     records: list[dict[str, Any]],
     trace: str | None = None,
@@ -343,6 +471,7 @@ def main(argv: list[str]) -> int:
     headers = [r for r in records if r.get("type") == "flight_header"]
     alerts = alert_digest(records)
     perf = perf_digest(records)
+    learning = learning_digest(records, alerts)
     rows = timeline(records, trace=args.trace, window=args.window)
     if args.tail and len(rows) > args.tail:
         clipped, rows = len(rows) - args.tail, rows[-args.tail:]
@@ -358,6 +487,7 @@ def main(argv: list[str]) -> int:
             ],
             "alerts": alerts,
             "perf": perf,
+            "learning": learning,
             "timeline": rows,
             "clipped": clipped,
         }, indent=2, default=str))
@@ -387,6 +517,9 @@ def main(argv: list[str]) -> int:
         print("\nno alerts recorded")
     if perf:
         for line in render_perf(perf):
+            print(line)
+    if learning:
+        for line in render_learning(learning):
             print(line)
     print(
         f"\ntimeline ({len(rows)} records"

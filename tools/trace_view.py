@@ -106,6 +106,33 @@ def render_table(summary: dict) -> str:
             )
         for log_dir in device.get("profile_windows") or []:
             lines.append(f"  profile window: {log_dir}")
+    learning = summary.get("learning_plane")
+    if learning:
+        lines += [
+            "",
+            "learning plane (learning.round spans):",
+            f"  rounds     {learning['n_rounds']:>6}",
+        ]
+        for t in learning.get("tasks") or []:
+            lines.append(f"  task {t['task']} ({t['n_rounds']} round(s)):")
+            first, last = (
+                t.get("first_update_norm"), t.get("last_update_norm")
+            )
+            if first is not None and last is not None:
+                decay = t.get("norm_decay_pct")
+                lines.append(
+                    f"    update norm {first:.4g} -> {last:.4g}"
+                    + (f"  ({decay:+.1f}% decay)"
+                       if decay is not None else "")
+                )
+            if t.get("min_station_cos") is not None:
+                lines.append(
+                    f"    worst station cosine: {t['min_station_cos']:.3f}"
+                    + (f" (station {t['min_cos_station']})"
+                       if t.get("min_cos_station") is not None else "")
+                )
+            if t.get("last_loss") is not None:
+                lines.append(f"    last loss: {t['last_loss']:.4g}")
     return "\n".join(lines)
 
 

@@ -443,6 +443,17 @@ class UtilSubClient:
         the path to `tools/doctor.py` for the merged timeline."""
         return self.parent.request("POST", "debug/dump")
 
+    def rounds(self, task_id: int | None = None) -> dict[str, Any]:
+        """The server's learning-plane observatory (GET /api/rounds):
+        with a ``task_id``, that task's per-round history — loss, pooled
+        update norm (the convergence trajectory) and per-station
+        norms/cosines, the evidence behind `anomalous_station` /
+        `non_convergence` / `model_divergence` alerts; without one, the
+        index of tracked tasks with their convergence summaries."""
+        if task_id is None:
+            return self.parent.request("GET", "rounds")
+        return self.parent.request("GET", f"rounds/{task_id}")
+
     def debug_profile(self, seconds: float = 1.0) -> dict[str, Any]:
         """Open an on-demand jax.profiler window on the server (POST
         /api/debug/profile); returns ``{"path", "seconds", "trace_id"}``

@@ -170,6 +170,20 @@ class FlightRecorder:
                 records.append({"type": "alert", **alert})
         except Exception:
             pass
+        # learning-plane final state: each tracked task's convergence
+        # summary (the per-round evidence rides the notes ring; this
+        # survives even when the ring evicted the early rounds) — what
+        # the doctor's learning digest anchors its trajectory on
+        try:
+            from vantage6_tpu.runtime.learning import LEARNING
+
+            for summary in LEARNING.summaries():
+                if summary.get("rounds"):
+                    records.append({
+                        "type": "learning", "ts": time.time(), **summary,
+                    })
+        except Exception:
+            pass
         try:
             with open(path, "w") as fh:
                 for rec in records:

@@ -106,6 +106,26 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
      "raw/wire on-wire reduction of the latest compress"),
     ("v6t_compress_ef_norm", "gauge",
      "L2 norm of the most recent error-feedback accumulator"),
+    # learning plane (runtime.learning — docs/observability.md "learning
+    # plane"): convergence + per-station update-quality gauges; the
+    # station gauges summarize the LATEST recorded round (the full
+    # per-station table lives at GET /api/rounds/<task_id>)
+    ("v6t_round_updates_total", "counter",
+     "federated rounds recorded by the learning-plane observatory"),
+    ("v6t_round_update_norm", "gauge",
+     "L2 norm of the latest recorded pooled (global) update"),
+    ("v6t_round_loss", "gauge",
+     "mean training loss of the latest recorded round"),
+    ("v6t_round_norm_decay", "gauge",
+     "latest pooled update norm / peak norm so far (1.0 = not decaying)"),
+    ("v6t_station_update_norm_max", "gauge",
+     "largest per-station update L2 norm in the latest recorded round"),
+    ("v6t_station_cos_min", "gauge",
+     "smallest station cosine-to-pooled-update in the latest recorded "
+     "round"),
+    ("v6t_station_ef_norm_max", "gauge",
+     "largest per-station error-feedback mass in the latest recorded "
+     "round (compression armed)"),
     # tracing health (runtime.tracing)
     ("v6t_trace_spans_recorded_total", "counter", "spans recorded to the ring buffer"),
     ("v6t_trace_spans_dropped_total", "counter",

@@ -109,6 +109,14 @@ class FederationConfig:
     # error-feedback accumulators between rounds (docs/compression.md).
     # Typed Any so core stays import-light; validate() duck-checks it.
     compressor: Any = None
+    # Learning-plane recording of device-mode aggregations
+    # (docs/observability.md "learning plane"): every aggregate_stacked
+    # records per-station update stats into the process LEARNING
+    # registry. The stats pass pulls the [S, N] stacked result to host
+    # once per aggregation — set False where that transfer matters
+    # (large models on a real pod), same stance as
+    # FedAvgSpec.learning_stats.
+    learning_stats: bool = True
     stations: list[StationConfig] = dataclasses.field(default_factory=list)
     server: dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -230,6 +230,76 @@ def unflatten_stacked(template: Pytree, flat: jax.Array) -> Pytree:
     return jax.tree.unflatten(treedef, out)
 
 
+def station_update_stats(
+    flat: jax.Array,
+    weights: jax.Array | None = None,
+    mask: jax.Array | None = None,
+    ef: jax.Array | None = None,
+) -> dict[str, jax.Array]:
+    """Learning-plane statistics of one round's per-station updates — ONE
+    fused f32 pass over the flat-packed ``[S, N]`` rows (the same seam the
+    gradient-compression stack operates at; docs/observability.md
+    "learning plane"):
+
+    - ``station_norm`` [S]: each station's update L2 norm;
+    - ``station_cos`` [S]: cosine similarity of each station's delta to
+      the pooled (weighted-mean) delta — the per-client update-quality
+      signal async aggregation will accept/down-weight on. A label-flipped
+      or poisoned station shows up as a NEGATIVE/low cosine; a scaled one
+      as an outlier norm at cosine ~1;
+    - ``update_norm`` []: L2 norm of the pooled delta, the global
+      convergence signal (its decay trajectory is what the
+      ``model_divergence``/``non_convergence`` watchdog rules read);
+    - ``station_ef_norm`` [S] (only when ``ef`` is passed): per-station
+      error-feedback mass — the per-station refinement of the global
+      ``v6t_compress_ef_norm`` gauge.
+
+    The pooled delta uses ``fed_mean``'s exact weighting semantics
+    (f32, zero-weight stations nan-isolated, all-dropped guard), computed
+    here from the SAME formula regardless of the server-update mode — so
+    the stats are fp32-identical between the replicated and scattered
+    (ZeRO-1) paths by construction (the bench's parity assertion). The
+    per-station reductions are row-local (they ship [S] scalars under
+    GSPMD); the cosine leg needs the pooled vector once, which in
+    scattered mode costs one extra f32 reduction of N elements — cheap
+    next to local training, and `FedAvgSpec(learning_stats=False)` turns
+    the whole leg off where wire bytes matter.
+
+    Masked-out stations keep their (fictional, SPMD-computed) norm/cos —
+    they are excluded from the POOLED delta, and zeroing them here would
+    hide exactly the diverging-station evidence the stats exist to
+    surface. The effective weight vector rides along as
+    ``station_weight`` so host consumers (RoundHistory, the
+    ``anomalous_station`` rule) can tell a participating station from a
+    masked-out one — an alert must never name a station the operator
+    already excluded.
+    """
+    x = flat.astype(jnp.float32)
+    s = x.shape[0]
+    w = _norm_weights(s, weights, mask)
+    norms = jnp.sqrt(jnp.sum(x * x, axis=1))
+    total = jnp.sum(w)
+    denom = jnp.where(total > 0, total, 1.0)
+    ww = w.reshape(-1, 1)
+    # same nan-isolation as _weighted_leaf_sum: a crashed station's
+    # inf/nan delta must not poison the pooled update (nan * 0 == nan)
+    safe = jnp.where(ww != 0, x, jnp.zeros((), jnp.float32))
+    pooled = jnp.sum(safe * ww, axis=0) / denom
+    update_norm = jnp.sqrt(jnp.sum(pooled * pooled))
+    dots = x @ pooled
+    cos = dots / jnp.maximum(norms * update_norm, 1e-12)
+    out = {
+        "station_norm": norms,
+        "station_cos": cos,
+        "update_norm": update_norm,
+        "station_weight": w,
+    }
+    if ef is not None:
+        e = ef.astype(jnp.float32)
+        out["station_ef_norm"] = jnp.sqrt(jnp.sum(e * e, axis=1))
+    return out
+
+
 def _local_weighted_flat_sum(
     local_stacked: Pytree, local_w: jax.Array
 ) -> jax.Array:

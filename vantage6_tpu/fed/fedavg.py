@@ -36,6 +36,7 @@ from vantage6_tpu.fed.collectives import (
     flatten_stacked,
     flatten_tree,
     padded_flat_size,
+    station_update_stats,
     unflatten_like,
     unflatten_stacked,
 )
@@ -77,6 +78,14 @@ class FedAvgSpec:
     # aggregation consumes the DECOMPRESSED deltas, so this composes with
     # both the replicated and the scattered (ZeRO-1) server update.
     compressor: CompressorSpec | None = None
+    # Learning-plane statistics (docs/observability.md "learning plane"):
+    # per-station update L2 norms, cosine-to-pooled-delta, per-station EF
+    # mass and the global update norm, computed INSIDE the jitted round at
+    # the flat-pack seam (collectives.station_update_stats) and returned
+    # as the 4th element of round()/run_rounds(). fp32-identical between
+    # the replicated and scattered update paths. Off = stats come back as
+    # an empty dict and the round pays nothing for them.
+    learning_stats: bool = True
 
 
 class FedAvg:
@@ -93,6 +102,9 @@ class FedAvg:
             spec.compressor is not None and not spec.compressor.identity
         )
         self.server_opt = spec.server_optimizer or optax.sgd(1.0)
+        # optional learning-plane sink (attach_history): when set, every
+        # round()/run_rounds() host-records its stats into it
+        self.history: Any = None
         # NOTE: no buffer donation here — callers legitimately reuse params
         # across round() calls (e.g. ablations from one init); the scan in
         # run_rounds already reuses buffers internally. All three
@@ -177,13 +189,25 @@ class FedAvg:
         # compressed uplink — and the per-station error-feedback
         # accumulators ride the optimizer-state carry to the next round.
         ef = None
+        flat = None
         if self._compressing:
             server_state = opt_state["server"]
-            deltas, ef = self._compress_deltas(
+            deltas, ef, flat = self._compress_deltas(
                 deltas, opt_state["ef"], round_key, mask
             )
         else:
             server_state = opt_state
+        # learning-plane stats at the flat-pack seam, BEFORE the server
+        # update: computed on the (reconstructed, post-decompression)
+        # deltas the aggregation actually consumes, by one shared formula
+        # independent of the update mode — replicated and scattered rounds
+        # report fp32-identical stats (bench parity assertion). When
+        # compressing, the flat matrix from the compression pass is reused.
+        stats: dict[str, Any] = {}
+        if self.spec.learning_stats:
+            if flat is None:
+                flat = flatten_stacked(deltas)
+            stats = station_update_stats(flat, weights=weights, ef=ef)
         if self.spec.shard_server_update:
             params, server_state = self._sharded_server_update(
                 params, server_state, deltas, weights
@@ -202,16 +226,18 @@ class FedAvg:
             if self._compressing
             else server_state
         )
-        return params, new_state, round_loss
+        return params, new_state, round_loss, stats
 
     def _compress_deltas(
         self, deltas: Pytree, ef: jax.Array, round_key: jax.Array,
         mask: jax.Array,
-    ) -> tuple[Pytree, jax.Array]:
+    ) -> tuple[Pytree, jax.Array, jax.Array]:
         """Per-station compress -> decompress of the delta uplink (the
         flat-pack seam): error feedback re-injected before compressing,
         ``comm_dtype`` applied as the pre-quantization cast (cast, then
-        quantize). Returns the reconstructed deltas + new EF [S, N].
+        quantize). Returns the reconstructed deltas + new EF [S, N] + the
+        reconstructed flat [S, N] matrix (reused by the learning-stats
+        pass so the round never flat-packs twice).
         Pure/traced — runs inside the round program; wire accounting
         happens host-side in round()/run_rounds().
 
@@ -234,7 +260,7 @@ class FedAvg:
         )
         participating = (mask != 0).reshape(-1, 1)
         new_ef = jnp.where(participating, new_ef, ef)
-        return unflatten_stacked(template, hat), new_ef
+        return unflatten_stacked(template, hat), new_ef, hat
 
     def _sharded_server_update(
         self, params: Pytree, opt_state: Any, deltas: Pytree,
@@ -316,13 +342,20 @@ class FedAvg:
         key: jax.Array,
         mask: jax.Array | None = None,
     ):
-        """One federated round. Returns (params, opt_state, mean_loss)."""
+        """One federated round. Returns (params, opt_state, mean_loss,
+        stats) — ``stats`` is the learning-plane dict from
+        ``collectives.station_update_stats`` ({} when
+        ``spec.learning_stats`` is off); feed it to a
+        ``runtime.learning.RoundHistory`` to arm convergence tracking and
+        the anomalous-station watchdog rules."""
         if mask is None:
             mask = jnp.ones_like(counts)
         self._record_wire(params)
-        return self._round(
+        out = self._round(
             params, opt_state, stacked_x, stacked_y, counts, mask, key
         )
+        self._record_history(out[2], out[3])
+        return out
 
     def _record_wire(self, params: Pytree, n_rounds: int = 1) -> None:
         """Host-side wire accounting for the compressed delta uplink
@@ -364,7 +397,11 @@ class FedAvg:
         donate: bool = True,
     ):
         """`n_rounds` federated rounds as ONE compiled program (lax.scan) —
-        the benchmark fast path. Returns (params, opt_state, losses[n]).
+        the benchmark fast path. Returns (params, opt_state, losses[n],
+        stats) — ``stats`` holds the per-round learning-plane arrays
+        stacked over the scan axis (``station_norm``/``station_cos``
+        ``[n, S]``, ``update_norm`` ``[n]``; {} when
+        ``spec.learning_stats`` is off).
 
         Pass the ``opt_state`` from a checkpoint to CONTINUE a run (resuming
         FedAdam etc. without resetting server-optimizer moments); omitted, a
@@ -384,10 +421,42 @@ class FedAvg:
             opt_state = self.init(params)
         self._record_wire(params, n_rounds=n_rounds)
         run = self._run_donating if donate else self._run
-        return run(
+        out = run(
             params, opt_state, stacked_x, stacked_y, counts, mask, key,
             n_rounds=n_rounds,
         )
+        self._record_history(out[2], out[3])
+        return out
+
+    # --------------------------------------------------------- learning plane
+    def attach_history(self, history: Any) -> Any:
+        """Attach a ``runtime.learning.RoundHistory`` (or a registry key —
+        resolved through the process ``LEARNING`` registry): every
+        round()/run_rounds() call then host-records its stats into it
+        (telemetry gauges, flight notes, a ``learning.round`` span on the
+        ambient trace — the learning-plane observatory). Recording pulls
+        the tiny [S] stat vectors to host, which BLOCKS on the round's
+        completion — attach when observing, not when racing dispatches.
+        Returns the history. Pass None to detach."""
+        if history is not None and not hasattr(history, "record_engine"):
+            from vantage6_tpu.runtime.learning import LEARNING
+
+            history = LEARNING.history(history)
+        self.history = history
+        return history
+
+    def _record_history(self, losses: Any, stats: Any) -> None:
+        history = getattr(self, "history", None)
+        if history is None or not stats:
+            return
+        try:
+            history.record_engine(losses, stats)
+        except Exception:  # observability must never fail the round
+            import logging
+
+            logging.getLogger("vantage6_tpu/fedavg").debug(
+                "round-history recording failed", exc_info=True
+            )
 
     def _run_impl(
         self, params, opt_state, stacked_x, stacked_y, counts, mask, key,
@@ -396,13 +465,13 @@ class FedAvg:
 
         def body(carry, round_key):
             p, s = carry
-            p, s, loss = self._round_impl(
+            p, s, loss, stats = self._round_impl(
                 p, s, stacked_x, stacked_y, counts, mask, round_key
             )
-            return (p, s), loss
+            return (p, s), (loss, stats)
 
         keys = jax.random.split(key, n_rounds)
-        (params, opt_state), losses = jax.lax.scan(
+        (params, opt_state), (losses, stats) = jax.lax.scan(
             body, (params, opt_state), keys
         )
-        return params, opt_state, losses
+        return params, opt_state, losses, stats

@@ -30,14 +30,25 @@ class TrainState:
     opt_state: Any
     round_index: int
     rng_key: Any
+    # learning-plane round history (runtime.learning.RoundHistory
+    # .state_arrays()): checkpointing it keeps the norm-decay trajectory
+    # CONTINUOUS across a resume, so the watchdog's non_convergence /
+    # model_divergence rules never see a restart as a fresh (alarming)
+    # trajectory. Optional and absent-tolerant both ways: old checkpoints
+    # restore with history=None, and a None history writes the exact
+    # pre-learning-plane tree.
+    history: Any = None
 
     def as_pytree(self) -> dict[str, Any]:
-        return {
+        tree = {
             "params": self.params,
             "opt_state": self.opt_state,
             "round_index": np.asarray(self.round_index, np.int64),
             "rng_key": jax.random.key_data(self.rng_key),
         }
+        if self.history is not None:
+            tree["history"] = self.history
+        return tree
 
     @classmethod
     def from_pytree(cls, tree: dict[str, Any]) -> "TrainState":
@@ -48,6 +59,7 @@ class TrainState:
             rng_key=jax.random.wrap_key_data(
                 np.asarray(tree["rng_key"], dtype=np.uint32)
             ),
+            history=tree.get("history"),
         )
 
 

@@ -31,6 +31,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from vantage6_tpu.algorithm.context import (
     AlgorithmEnvironment,
@@ -845,24 +846,72 @@ class Federation:
             require_parent=True,
         ):
             if agg_mode == "replicated":
-                return fed_mean(
+                out = fed_mean(
                     task.stacked_result, weights=weights,
                     mask=task.participation,
                 )
-            if agg_mode not in ("scattered", "scattered_bf16"):
+            elif agg_mode not in ("scattered", "scattered_bf16"):
                 raise ValueError(
                     f"unknown agg_mode {agg_mode!r} (replicated | scattered"
                     " | scattered_bf16)"
                 )
-            return fed_mean_scattered_tree(
-                self.mesh,
-                task.stacked_result,
-                weights=weights,
-                mask=task.participation,
-                comm_dtype=(
-                    jnp.bfloat16 if agg_mode == "scattered_bf16" else None
+            else:
+                out = fed_mean_scattered_tree(
+                    self.mesh,
+                    task.stacked_result,
+                    weights=weights,
+                    mask=task.participation,
+                    comm_dtype=(
+                        jnp.bfloat16 if agg_mode == "scattered_bf16" else None
+                    ),
+                )
+        # OUTSIDE the aggregate span: the stats pass blocks on a
+        # device->host pull of the stacked result, which must not inflate
+        # the aggregation-latency telemetry it sits next to
+        self._record_learning(task, weights)
+        return out
+
+    def _record_learning(self, task: "Task", weights: Any) -> None:
+        """Learning-plane record of one device-mode aggregation
+        (docs/observability.md "learning plane"): per-station update
+        stats of the stacked result, keyed by the PARENT task when one
+        exists — the reference central loop creates a fresh subtask per
+        round, so the parent's id is the stable per-run history key and
+        its rounds accumulate into one trajectory. Fail-soft: the
+        learning plane must never fail an aggregation. Gated by
+        ``FederationConfig.learning_stats`` (the [S, N] host pull is the
+        cost — see core/config.py)."""
+        if not getattr(self.config, "learning_stats", True):
+            return
+        try:
+            from vantage6_tpu.fed.collectives import flatten_stacked
+            from vantage6_tpu.runtime.learning import LEARNING, update_stats_host
+
+            key = task.parent_id if task.parent_id is not None else task.id
+            flat = np.asarray(flatten_stacked(task.stacked_result))
+            stats = update_stats_host(
+                flat,
+                weights=None if weights is None else np.asarray(weights),
+                mask=(
+                    None if task.participation is None
+                    else np.asarray(task.participation)
                 ),
             )
+            LEARNING.history(key).record_stats(stats)
+        except Exception:
+            import logging
+
+            logging.getLogger("vantage6_tpu/federation").debug(
+                "learning-plane recording failed for task %s",
+                getattr(task, "id", "?"), exc_info=True,
+            )
+
+    def learning_history(self, task_id: int):
+        """The learning-plane RoundHistory recorded for ``task_id`` (its
+        own id or, for per-round subtasks, the parent's), or None."""
+        from vantage6_tpu.runtime.learning import LEARNING
+
+        return LEARNING.get(task_id)
 
     # ------------------------------------------------- gradient compression
     def compress_update(

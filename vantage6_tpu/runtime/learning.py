@@ -1,0 +1,607 @@
+"""Learning-plane observatory: per-station update telemetry + convergence.
+
+PRs 5/8/9 made the task plane, ops plane and device plane observable; the
+LEARNING plane — is the model converging, is a station feeding it garbage
+— was still a black box: a round that "succeeds" can carry a diverging
+model or a poisoned/label-flipped station and nothing notices until
+accuracy is inspected by hand. This module is the host side of that
+fourth plane (docs/observability.md "learning plane"):
+
+- **Statistics** come from ``fed.collectives.station_update_stats`` — one
+  fused pass over the flat-packed ``[S, N]`` per-station deltas inside
+  the jitted FedAvg round (per-station L2 norms, cosine-to-pooled-delta,
+  per-station error-feedback mass, global update norm), fp32-identical
+  between the replicated and scattered (ZeRO-1) update paths.
+  :func:`update_stats_host` is the numpy twin for host-plane callers
+  (Federation device-mode aggregations, the REST client side).
+- **RoundHistory** is the bounded per-task record of those stats. Each
+  :meth:`RoundHistory.record` feeds the ``v6t_round_*`` /
+  ``v6t_station_*`` telemetry series, drops a ``learning_round`` flight
+  note, and emits a ``learning.round`` span (with a ``round_recorded``
+  event) on the ambient trace — so a round's learning stats land inside
+  the round's own distributed trace for `tools/trace_view.py` /
+  `tools/doctor.py` to merge. History state round-trips through
+  :meth:`RoundHistory.state_arrays` so a checkpoint/restore keeps the
+  norm-decay trajectory CONTINUOUS (no spurious ``non_convergence`` /
+  ``model_divergence`` raise after a resume — ``runtime.checkpoint``'s
+  ``TrainState.history`` carries it).
+- **LEARNING** is the process-wide registry (same stance as
+  ``TRACER``/``REGISTRY``/``WATCHDOG``): keyed histories, a watchdog feed
+  (``learning_rounds`` + ``learning_tasks`` items the
+  ``anomalous_station`` / ``non_convergence`` / ``model_divergence``
+  rules read), and the state behind the server's ``GET /api/rounds``.
+
+This is the per-client signal substrate the FedBuff-style async
+aggregation PR (ROADMAP item 2) will consume to accept/down-weight
+updates — the exact per-client problem PAPERS.md's CLIP paper targets.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any
+
+import numpy as np
+
+from vantage6_tpu.common.telemetry import REGISTRY
+from vantage6_tpu.runtime.tracing import TRACER
+
+# how many recent round items each history contributes to the watchdog
+# feed / API summaries; the anomalous-station window (default 8) and the
+# non-convergence window (default 16) must both fit inside it
+_FEED_ROUNDS = 24
+
+
+def update_stats_host(
+    flat: Any,
+    weights: Any = None,
+    mask: Any = None,
+    ef: Any = None,
+) -> dict[str, Any]:
+    """Numpy twin of ``fed.collectives.station_update_stats`` for host
+    planes (Federation device-mode aggregations, REST clients): same
+    weighting/nan-isolation semantics, plain float outputs, no jax
+    dispatch. ``flat`` is the ``[S, N]`` per-station flat-pack."""
+    x = np.asarray(flat, np.float32).reshape(len(flat), -1)
+    s = x.shape[0]
+    w = (
+        np.ones((s,), np.float32)
+        if weights is None
+        else np.asarray(weights, np.float32)
+    )
+    if mask is not None:
+        w = w * np.asarray(mask, np.float32)
+    norms = np.sqrt(np.sum(x * x, axis=1))
+    total = float(np.sum(w))
+    denom = total if total > 0 else 1.0
+    ww = w.reshape(-1, 1)
+    safe = np.where(ww != 0, x, np.float32(0.0))
+    pooled = np.sum(safe * ww, axis=0) / np.float32(denom)
+    update_norm = float(np.sqrt(np.sum(pooled * pooled)))
+    cos = (x @ pooled) / np.maximum(norms * update_norm, 1e-12)
+    out: dict[str, Any] = {
+        "station_norm": norms,
+        "station_cos": cos,
+        "update_norm": update_norm,
+        "station_weight": w,
+    }
+    if ef is not None:
+        e = np.asarray(ef, np.float32).reshape(s, -1)
+        out["station_ef_norm"] = np.sqrt(np.sum(e * e, axis=1))
+    return out
+
+
+def _finite(v: Any) -> float:
+    f = float(v)
+    return f if math.isfinite(f) else 0.0
+
+
+class RoundHistory:
+    """Bounded per-task trajectory of learning-plane round records.
+
+    One record per federated round: loss, global update norm, per-station
+    norms/cosines (+ EF mass when compression is armed). ``rounds_total``
+    and ``peak_norm`` survive ring eviction, so the convergence summary
+    stays truthful for runs longer than the ring.
+    """
+
+    def __init__(self, key: Any, maxlen: int = 256):
+        self.key = key
+        self._lock = threading.Lock()
+        self._records: deque[dict[str, Any]] = deque(  # guarded-by: _lock
+            maxlen=max(8, maxlen)
+        )
+        # watchdog-feed round items, PREBUILT at record time: records are
+        # immutable once stored, so rebuilding station dicts + medians on
+        # every evaluation tick would be repeated wasted work
+        self._feed_rounds: deque[dict[str, Any]] = deque(  # guarded-by: _lock
+            maxlen=_FEED_ROUNDS
+        )
+        self.rounds_total = 0  # guarded-by: _lock (survives eviction)
+        self.peak_norm = 0.0  # guarded-by: _lock
+        self.first_norm: float | None = None  # guarded-by: _lock
+
+    # ---------------------------------------------------------------- record
+    def record(
+        self,
+        update_norm: float,
+        station_norms: Any,
+        station_cos: Any,
+        loss: float | None = None,
+        station_ef_norms: Any = None,
+        station_weights: Any = None,
+        round_index: int | None = None,
+        ts: float | None = None,
+    ) -> dict[str, Any]:
+        """Record one round. Emits telemetry (``v6t_round_*`` /
+        ``v6t_station_*``), a ``learning_round`` flight note, and a
+        ``learning.round`` span on the ambient trace (no-op outside one).
+        ``station_weights`` is the round's effective weight vector
+        (``station_update_stats``'s ``station_weight``): a zero-weight
+        station was masked out of the pooled update, and its (fictional,
+        SPMD-computed) stats are recorded but EXCLUDED from the station
+        gauges, the feed medians, and the anomaly evidence — an alert
+        must never name a station the operator already dropped. Returns
+        the stored record."""
+        norms = [_finite(v) for v in np.asarray(station_norms).ravel()]
+        cosines = [_finite(v) for v in np.asarray(station_cos).ravel()]
+        efs = (
+            None
+            if station_ef_norms is None
+            else [_finite(v) for v in np.asarray(station_ef_norms).ravel()]
+        )
+        weights = (
+            None
+            if station_weights is None
+            else [_finite(v) for v in np.asarray(station_weights).ravel()]
+        )
+        participating = [
+            weights is None or (s < len(weights) and weights[s] > 0)
+            for s in range(len(norms))
+        ]
+        gnorm = _finite(update_norm)
+        with self._lock:
+            idx = (
+                int(round_index)
+                if round_index is not None
+                else self.rounds_total
+            )
+            rec: dict[str, Any] = {
+                "round": idx,
+                "ts": float(ts) if ts is not None else time.time(),
+                "loss": None if loss is None else _finite(loss),
+                "update_norm": gnorm,
+                "station_norms": norms,
+                "station_cos": cosines,
+            }
+            if efs is not None:
+                rec["station_ef_norms"] = efs
+            if weights is not None:
+                rec["station_weights"] = weights
+            self._records.append(rec)
+            self._feed_rounds.append(
+                self._build_feed_item(rec, participating)
+            )
+            self.rounds_total += 1
+            if self.first_norm is None:
+                self.first_norm = gnorm
+            self.peak_norm = max(self.peak_norm, gnorm)
+            peak = self.peak_norm
+        self._emit(rec, peak, participating)
+        return rec
+
+    def _build_feed_item(
+        self, rec: dict[str, Any], participating: list[bool]
+    ) -> dict[str, Any]:
+        """One watchdog-feed round item, built once at record time (the
+        record is immutable after). Medians and anomaly evidence cover
+        PARTICIPATING stations only."""
+        norms = rec["station_norms"]
+        live_norms = [
+            norms[s] for s in range(len(norms)) if participating[s]
+        ]
+        stations = [
+            {
+                "station": s,
+                "norm": norms[s],
+                "cos": rec["station_cos"][s]
+                if s < len(rec["station_cos"]) else None,
+                "participating": participating[s],
+            }
+            for s in range(len(norms))
+        ]
+        return {
+            "task": self.key,
+            "round": rec["round"],
+            "ts": rec["ts"],
+            "update_norm": rec["update_norm"],
+            "median_norm": (
+                float(np.median(live_norms)) if live_norms else 0.0
+            ),
+            "stations": stations,
+        }
+
+    def record_stats(
+        self,
+        stats: dict[str, Any],
+        loss: float | None = None,
+        round_index: int | None = None,
+    ) -> dict[str, Any]:
+        """Record one ``station_update_stats`` dict (device or host) —
+        the shape the FedAvg engine and ``update_stats_host`` produce."""
+        return self.record(
+            update_norm=stats["update_norm"],
+            station_norms=stats["station_norm"],
+            station_cos=stats["station_cos"],
+            station_ef_norms=stats.get("station_ef_norm"),
+            station_weights=stats.get("station_weight"),
+            loss=loss,
+            round_index=round_index,
+        )
+
+    def record_engine(
+        self, losses: Any, stats: dict[str, Any], start_round: int | None = None
+    ) -> list[dict[str, Any]]:
+        """Host-record a FedAvg ``round()`` (scalar stats) or
+        ``run_rounds()`` (scan-stacked ``[n, ...]`` stats) result. Pulls
+        the [S]-sized stat vectors to host — blocks on the device work."""
+        if not stats:
+            return []
+        gnorm = np.asarray(stats["update_norm"])
+        norms = np.asarray(stats["station_norm"])
+        cosines = np.asarray(stats["station_cos"])
+        efs = stats.get("station_ef_norm")
+        efs = None if efs is None else np.asarray(efs)
+        weights = stats.get("station_weight")
+        weights = None if weights is None else np.asarray(weights)
+        loss_arr = None if losses is None else np.asarray(losses)
+        with self._lock:
+            base = self.rounds_total if start_round is None else start_round
+        if gnorm.ndim == 0:  # a single round()
+            return [self.record(
+                update_norm=gnorm,
+                station_norms=norms,
+                station_cos=cosines,
+                station_ef_norms=efs,
+                station_weights=weights,
+                loss=None if loss_arr is None else loss_arr,
+                round_index=base,
+            )]
+        return [
+            self.record(
+                update_norm=gnorm[r],
+                station_norms=norms[r],
+                station_cos=cosines[r],
+                station_ef_norms=None if efs is None else efs[r],
+                station_weights=None if weights is None else weights[r],
+                loss=None if loss_arr is None else loss_arr[r],
+                round_index=base + r,
+            )
+            for r in range(gnorm.shape[0])
+        ]
+
+    def _emit(
+        self, rec: dict[str, Any], peak: float, participating: list[bool]
+    ) -> None:
+        REGISTRY.counter("v6t_round_updates_total").inc()
+        REGISTRY.gauge("v6t_round_update_norm").set(rec["update_norm"])
+        if rec["loss"] is not None:
+            REGISTRY.gauge("v6t_round_loss").set(rec["loss"])
+        # <= 1 while the norm shrinks below its peak; 1.0 = stalled at (or
+        # returned to) the peak — the non_convergence rule's quick gauge
+        REGISTRY.gauge("v6t_round_norm_decay").set(
+            rec["update_norm"] / peak if peak > 0 else 1.0
+        )
+        # the station gauges summarize PARTICIPATING stations only — a
+        # masked-out station's fictional stats must not pin cos_min
+        live = [s for s in range(len(rec["station_norms"]))
+                if participating[s]]
+        if live:
+            REGISTRY.gauge("v6t_station_update_norm_max").set(
+                max(rec["station_norms"][s] for s in live)
+            )
+        live_cos = [s for s in live if s < len(rec["station_cos"])]
+        if live_cos:
+            REGISTRY.gauge("v6t_station_cos_min").set(
+                min(rec["station_cos"][s] for s in live_cos)
+            )
+        efs = rec.get("station_ef_norms")
+        if efs:
+            live_ef = [s for s in live if s < len(efs)]
+            if live_ef:
+                REGISTRY.gauge("v6t_station_ef_norm_max").set(
+                    max(efs[s] for s in live_ef)
+                )
+        attrs: dict[str, Any] = {
+            "task": self.key,
+            "round": rec["round"],
+            "update_norm": rec["update_norm"],
+            "n_stations": len(rec["station_norms"]),
+        }
+        if rec["loss"] is not None:
+            attrs["loss"] = rec["loss"]
+        if live_cos:
+            worst = min(live_cos, key=rec["station_cos"].__getitem__)
+            attrs["min_cos"] = rec["station_cos"][worst]
+            attrs["min_cos_station"] = worst
+        # the span is how the learning stats land INSIDE the round's own
+        # distributed trace (require_parent: an untraced training loop
+        # must not mint a root trace per round)
+        with TRACER.span(
+            "learning.round", kind="learning", service="learning",
+            attrs=attrs, require_parent=True,
+        ) as sp:
+            sp.add_event("round_recorded", round=rec["round"])
+        try:
+            from vantage6_tpu.common.flight import FLIGHT
+
+            FLIGHT.note("learning_round", task=self.key, **{
+                k: v for k, v in rec.items() if k != "ts"
+            })
+        except Exception:  # pragma: no cover - recorder must stay optional
+            pass
+
+    # --------------------------------------------------------------- queries
+    def rounds(self, limit: int | None = None) -> list[dict[str, Any]]:
+        with self._lock:
+            out = list(self._records)
+        return out[-limit:] if limit else out
+
+    def summary(self) -> dict[str, Any]:
+        """Convergence view: first/last/peak norm, overall decay, and a
+        per-station contribution table (mean norm/cos, min cos) over the
+        retained window — what the doctor's learning digest renders."""
+        with self._lock:
+            recs = list(self._records)
+            total = self.rounds_total
+            peak = self.peak_norm
+            first = self.first_norm
+        if not recs:
+            return {"task": self.key, "rounds": 0}
+        last = recs[-1]
+        decay_pct = (
+            100.0 * (1.0 - last["update_norm"] / first)
+            if first else None
+        )
+        n_stations = len(last["station_norms"])
+        stations = []
+        for s in range(n_stations):
+            norms = [
+                r["station_norms"][s] for r in recs
+                if s < len(r["station_norms"])
+            ]
+            cosines = [
+                r["station_cos"][s] for r in recs
+                if s < len(r["station_cos"])
+            ]
+            stations.append({
+                "station": s,
+                "mean_norm": sum(norms) / len(norms) if norms else None,
+                "mean_cos": sum(cosines) / len(cosines) if cosines else None,
+                "min_cos": min(cosines) if cosines else None,
+            })
+        return {
+            "task": self.key,
+            "rounds": total,
+            "first_update_norm": first,
+            "last_update_norm": last["update_norm"],
+            "peak_update_norm": peak,
+            "decay_pct": None if decay_pct is None else round(decay_pct, 2),
+            "last_loss": last["loss"],
+            "last_round": last["round"],
+            "stations": stations,
+        }
+
+    # ------------------------------------------------------------ checkpoint
+    def state_arrays(self) -> dict[str, Any]:
+        """Array-packed state for orbax checkpoints
+        (``runtime.checkpoint.TrainState.history``): the retained records
+        as dense numpy arrays plus the eviction-surviving scalars. Only
+        records matching the newest record's station count are packed —
+        a reshaped federation starts a fresh trajectory."""
+        with self._lock:
+            recs = list(self._records)
+            total = self.rounds_total
+            peak = self.peak_norm
+            first = self.first_norm
+        if recs:
+            s = len(recs[-1]["station_norms"])
+            recs = [r for r in recs if len(r["station_norms"]) == s]
+        has_ef = bool(recs) and all(
+            r.get("station_ef_norms") is not None for r in recs
+        )
+        has_w = bool(recs) and all(
+            r.get("station_weights") is not None for r in recs
+        )
+        out: dict[str, Any] = {
+            "round_index": np.asarray(
+                [r["round"] for r in recs], np.int64
+            ),
+            "ts": np.asarray([r["ts"] for r in recs], np.float64),
+            "loss": np.asarray(
+                [math.nan if r["loss"] is None else r["loss"] for r in recs],
+                np.float64,
+            ),
+            "update_norm": np.asarray(
+                [r["update_norm"] for r in recs], np.float64
+            ),
+            "station_norms": np.asarray(
+                [r["station_norms"] for r in recs], np.float32
+            ),
+            "station_cos": np.asarray(
+                [r["station_cos"] for r in recs], np.float32
+            ),
+            "rounds_total": np.asarray(total, np.int64),
+            "peak_norm": np.asarray(peak, np.float64),
+            "first_norm": np.asarray(
+                math.nan if first is None else first, np.float64
+            ),
+        }
+        if has_ef:
+            out["station_ef_norms"] = np.asarray(
+                [r["station_ef_norms"] for r in recs], np.float32
+            )
+        if has_w:
+            out["station_weights"] = np.asarray(
+                [r["station_weights"] for r in recs], np.float32
+            )
+        return out
+
+    def load_state(self, state: dict[str, Any]) -> "RoundHistory":
+        """Restore from :meth:`state_arrays` — the records re-populate and
+        the telemetry gauges re-anchor to the LAST restored round (no
+        counter increments, no spans/notes: a restore is not new rounds),
+        so the norm-decay trajectory continues instead of restarting and
+        the trend rules see no spurious step."""
+        rounds = np.asarray(state["round_index"])
+        efs = state.get("station_ef_norms")
+        wts = state.get("station_weights")
+        recs = []
+        for i in range(rounds.shape[0]):
+            loss = float(np.asarray(state["loss"])[i])
+            rec: dict[str, Any] = {
+                "round": int(rounds[i]),
+                "ts": float(np.asarray(state["ts"])[i]),
+                "loss": None if math.isnan(loss) else loss,
+                "update_norm": float(np.asarray(state["update_norm"])[i]),
+                "station_norms": [
+                    float(v) for v in np.asarray(state["station_norms"])[i]
+                ],
+                "station_cos": [
+                    float(v) for v in np.asarray(state["station_cos"])[i]
+                ],
+            }
+            if efs is not None:
+                rec["station_ef_norms"] = [
+                    float(v) for v in np.asarray(efs)[i]
+                ]
+            if wts is not None:
+                rec["station_weights"] = [
+                    float(v) for v in np.asarray(wts)[i]
+                ]
+            recs.append(rec)
+        first = float(np.asarray(state["first_norm"]))
+        with self._lock:
+            self._records.clear()
+            self._records.extend(recs)
+            # rebuild the prebuilt feed cache for the restored tail, so
+            # the rules' evidence window is continuous across the resume
+            self._feed_rounds.clear()
+            for rec in recs[-_FEED_ROUNDS:]:
+                w = rec.get("station_weights")
+                participating = [
+                    w is None or (s < len(w) and w[s] > 0)
+                    for s in range(len(rec["station_norms"]))
+                ]
+                self._feed_rounds.append(
+                    self._build_feed_item(rec, participating)
+                )
+            self.rounds_total = int(np.asarray(state["rounds_total"]))
+            self.peak_norm = float(np.asarray(state["peak_norm"]))
+            self.first_norm = None if math.isnan(first) else first
+            peak = self.peak_norm
+        if recs:
+            last = recs[-1]
+            REGISTRY.gauge("v6t_round_update_norm").set(last["update_norm"])
+            REGISTRY.gauge("v6t_round_norm_decay").set(
+                last["update_norm"] / peak if peak > 0 else 1.0
+            )
+            if last["loss"] is not None:
+                REGISTRY.gauge("v6t_round_loss").set(last["loss"])
+        return self
+
+    # ---------------------------------------------------------- watchdog feed
+    def feed_items(self) -> tuple[list[dict[str, Any]], dict[str, Any]]:
+        """(recent round items, one task item) for the watchdog feed.
+        Round items are the PREBUILT cache (one dict per record, built at
+        record time — immutable, so every evaluation tick reuses them
+        instead of rebuilding station dicts + medians)."""
+        with self._lock:
+            round_items = list(self._feed_rounds)
+            total = self.rounds_total
+            peak = self.peak_norm
+        task_item = {
+            "task": self.key,
+            "rounds": total,
+            "peak_norm": peak,
+            "recent_norms": [r["update_norm"] for r in round_items],
+        }
+        return round_items, task_item
+
+
+class LearningRegistry:
+    """Keyed RoundHistory registry (process-wide singleton ``LEARNING``).
+
+    Keys are task ids (ints on the server path) or caller-chosen strings
+    (engine runs). Bounded FIFO: a long-lived server tracking thousands
+    of tasks keeps the newest ``max_histories``.
+    """
+
+    def __init__(self, max_histories: int = 512):
+        self._lock = threading.Lock()
+        self._histories: "OrderedDict[Any, RoundHistory]" = (
+            OrderedDict()
+        )  # guarded-by: _lock
+        self.max_histories = max(8, max_histories)
+
+    def history(self, key: Any, maxlen: int = 256) -> RoundHistory:
+        """Get-or-create the history for ``key``."""
+        with self._lock:
+            hist = self._histories.get(key)
+            if hist is None:
+                hist = self._histories[key] = RoundHistory(
+                    key, maxlen=maxlen
+                )
+                while len(self._histories) > self.max_histories:
+                    self._histories.popitem(last=False)
+            return hist
+
+    def get(self, key: Any) -> RoundHistory | None:
+        with self._lock:
+            return self._histories.get(key)
+
+    def keys(self) -> list[Any]:
+        with self._lock:
+            return list(self._histories)
+
+    def summaries(self) -> list[dict[str, Any]]:
+        with self._lock:
+            hists = list(self._histories.values())
+        return [h.summary() for h in hists]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._histories.clear()
+
+    def feed(self) -> dict[str, Any]:
+        """The watchdog's learning-plane feed: recent round items across
+        every tracked history (``learning_rounds`` — the
+        ``anomalous_station`` rule's evidence) plus one per-task
+        convergence item (``learning_tasks`` — ``non_convergence`` /
+        ``model_divergence``). Fail-soft by construction: pure reads of
+        bounded state."""
+        with self._lock:
+            hists = list(self._histories.values())
+        rounds: list[dict[str, Any]] = []
+        tasks: list[dict[str, Any]] = []
+        for h in hists:
+            r, t = h.feed_items()
+            rounds.extend(r)
+            tasks.append(t)
+        rounds.sort(key=lambda r: r.get("ts") or 0.0)
+        return {"learning_rounds": rounds, "learning_tasks": tasks}
+
+
+LEARNING = LearningRegistry()
+
+
+# feed the process watchdog (same import-time pattern as the device
+# observatory's "device_plane" feed): the three learning rules read this
+try:
+    from vantage6_tpu.runtime.watchdog import WATCHDOG as _WATCHDOG
+
+    _WATCHDOG.register_feed("learning", LEARNING.feed)
+except Exception:  # pragma: no cover - watchdog must stay optional here
+    pass

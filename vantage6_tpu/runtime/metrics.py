@@ -209,6 +209,16 @@ def rest_stats_snapshot() -> dict[str, Any]:
     return REST_STATS.snapshot()
 
 
+def learning_snapshot() -> list[dict[str, Any]]:
+    """Process-wide learning-plane summaries (`runtime.learning.LEARNING`):
+    one convergence view per tracked task — rounds, first/last/peak pooled
+    update norm, decay, per-station contribution table. The one import
+    point for observability consumers, like `wire_stats_snapshot`."""
+    from vantage6_tpu.runtime.learning import LEARNING
+
+    return LEARNING.summaries()
+
+
 def device_memory_all() -> list[dict[str, Any]]:
     """Memory census of EVERY local device: ``{id, platform,
     bytes_in_use, peak_bytes}`` per device, empty on backends that report

@@ -668,6 +668,67 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
                 for s in profile_spans
             ],
         }
+    # learning-plane call-out (docs/observability.md "learning plane"):
+    # the convergence trajectory and worst-station signal read straight
+    # off the learning.round spans the RoundHistory emits per round
+    learning_plane = None
+    learning_spans = [s for s in spans if s.get("name") == "learning.round"]
+    if learning_spans:
+        # trajectories are PER TASK: summarize() accepts multi-trace
+        # input, and a first->last norm computed across interleaved
+        # tasks' rounds would fabricate a convergence number from
+        # unrelated runs (same cross-task stance as anomalous_station)
+        by_task: dict[str, list[dict[str, Any]]] = {}
+        for s in learning_spans:
+            by_task.setdefault(
+                str((s.get("attrs") or {}).get("task")), []
+            ).append(s)
+
+        def _key(s: dict[str, Any]):
+            a = s.get("attrs") or {}
+            r = a.get("round")
+            return (0, r) if isinstance(r, (int, float)) else (1, s.get("ts") or 0)
+
+        tasks = []
+        for task, t_spans in by_task.items():
+            t_spans.sort(key=_key)
+            norms = [
+                (s.get("attrs") or {}).get("update_norm")
+                for s in t_spans
+            ]
+            norms = [n for n in norms if isinstance(n, (int, float))]
+            worst_cos = None
+            worst_station = None
+            for s in t_spans:
+                a = s.get("attrs") or {}
+                c = a.get("min_cos")
+                if isinstance(c, (int, float)) and (
+                    worst_cos is None or c < worst_cos
+                ):
+                    worst_cos = c
+                    worst_station = a.get("min_cos_station")
+            losses = [
+                (s.get("attrs") or {}).get("loss") for s in t_spans
+            ]
+            losses = [v for v in losses if isinstance(v, (int, float))]
+            tasks.append({
+                "task": task,
+                "n_rounds": len(t_spans),
+                "first_update_norm": norms[0] if norms else None,
+                "last_update_norm": norms[-1] if norms else None,
+                "norm_decay_pct": (
+                    round(100.0 * (1.0 - norms[-1] / norms[0]), 2)
+                    if len(norms) > 1 and norms[0] else None
+                ),
+                "min_station_cos": worst_cos,
+                "min_cos_station": worst_station,
+                "last_loss": losses[-1] if losses else None,
+            })
+        tasks.sort(key=lambda t: -t["n_rounds"])
+        learning_plane = {
+            "n_rounds": len(learning_spans),
+            "tasks": tasks,
+        }
     return {
         "n_spans": len(spans),
         "n_traces": len(traces),
@@ -676,6 +737,7 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "straggler": straggler,
         "compression": compression,
         "device_plane": device_plane,
+        "learning_plane": learning_plane,
     }
 
 

@@ -193,34 +193,218 @@ def _check_daemon_lapsed(ctx: RuleContext) -> list[dict[str, Any]]:
     return findings
 
 
+def station_window_flags(
+    rounds: list[dict[str, Any]],
+    window: int,
+    flag_fn: Callable[[dict[str, Any]], Any],
+) -> tuple[dict[Any, int], dict[Any, tuple[float, str]], int]:
+    """The ONE per-station rolling-window census the station-shaped rules
+    (``straggler_station``, ``anomalous_station``) share: scan the last
+    ``window`` round dicts, let ``flag_fn(round)`` yield zero or more
+    ``(key, score, detail)`` flags (a round may flag several stations),
+    and return ``(flag counts per key, worst (score, detail) per key,
+    rounds considered)``. "Worst" keeps the highest-score flag's
+    preformatted detail so each rule's message can name the offending
+    stat without re-deriving it."""
+    recent = rounds[-window:]
+    counts: dict[Any, int] = {}
+    worst: dict[Any, tuple[float, str]] = {}
+    for r in recent:
+        for key, score, detail in flag_fn(r) or ():
+            counts[key] = counts.get(key, 0) + 1
+            if key not in worst or score > worst[key][0]:
+                worst[key] = (float(score), str(detail))
+    return counts, worst, len(recent)
+
+
 def _check_straggler_station(ctx: RuleContext) -> list[dict[str, Any]]:
     need = int(ctx.config["straggler_rounds"])
     ratio = float(ctx.config["straggler_ratio"])
     window = int(ctx.config["straggler_window"])
-    rounds = ctx.feed_items("rounds")[-window:]
-    counts: dict[Any, int] = {}
-    worst: dict[Any, float] = {}
-    for r in rounds:
+
+    def flag(r: dict[str, Any]):
         station = r.get("straggler_station")
         mx = r.get("max_exec_s")
         mean = r.get("mean_exec_s")
         if station is None or not mx or not mean or r.get("n", 0) < 2:
-            continue
+            return ()
         if mx / mean >= ratio:
-            counts[station] = counts.get(station, 0) + 1
-            worst[station] = max(worst.get(station, 0.0), mx / mean)
+            return ((station, mx / mean, f"{mx / mean:.1f}x the round mean"),)
+        return ()
+
+    counts, worst, n_rounds = station_window_flags(
+        ctx.feed_items("rounds"), window, flag
+    )
     return [
         {
             "message": (
                 f"station {station} was the straggler in {n} of the last "
-                f"{len(rounds)} rounds (worst {worst[station]:.1f}x the "
-                f"round mean)"
+                f"{n_rounds} rounds (worst {worst[station][1]})"
             ),
             "labels": {"station": station},
         }
         for station, n in counts.items()
         if n >= need
     ]
+
+
+def _check_anomalous_station(ctx: RuleContext) -> list[dict[str, Any]]:
+    cos_thr = float(ctx.config["anomaly_cos_threshold"])
+    factor = float(ctx.config["anomaly_norm_factor"])
+    need = int(ctx.config["anomaly_rounds"])
+    window = int(ctx.config["anomaly_window"])
+
+    def flag(r: dict[str, Any]):
+        # keys are per-(task, station) already; the WINDOW below is
+        # applied per task too (see the grouping loop) — slicing the
+        # merged cross-task feed would let concurrent tasks dilute each
+        # other's evidence and a poisoned station would never reach the
+        # repeat threshold on a busy server
+        median = r.get("median_norm") or 0.0
+        pooled = r.get("update_norm") or 0.0
+        flags = []
+        for st in r.get("stations") or ():
+            station = st.get("station")
+            if station is None:
+                continue
+            # a masked-out station's stats are fictional (SPMD computes
+            # them, the pooled update excludes them) AND the documented
+            # remediation for this very alert is "mask the station" —
+            # flagging non-participants would make the alert impossible
+            # to clear by its own runbook
+            if st.get("participating") is False:
+                continue
+            key = (r.get("task"), station)
+            cos = st.get("cos")
+            norm = st.get("norm")
+            # cosine is only evidence when there is an update on BOTH
+            # sides: a zero-norm station (sent nothing this round) and a
+            # zero pooled update both degenerate to cos == 0, which is
+            # absence of signal, not a contrarian update
+            if (
+                isinstance(cos, (int, float))
+                and cos < cos_thr
+                and isinstance(norm, (int, float)) and norm > 0
+                and pooled > 0
+            ):
+                # score by how far below the threshold: the most
+                # contrarian round's cosine names the stat
+                flags.append((
+                    key, cos_thr - cos,
+                    f"cosine to the pooled update {cos:.3f} "
+                    f"(threshold {cos_thr:g})",
+                ))
+            elif (
+                isinstance(norm, (int, float))
+                and median > 0
+                and norm >= factor * median
+            ):
+                flags.append((
+                    key, norm / median,
+                    f"update norm {norm / median:.1f}x the station median "
+                    f"(threshold {factor:g}x)",
+                ))
+        return flags
+
+    by_task: dict[Any, list[dict[str, Any]]] = {}
+    for r in ctx.feed_items("learning_rounds"):
+        by_task.setdefault(r.get("task"), []).append(r)
+    findings = []
+    for rounds in by_task.values():
+        counts, worst, n_rounds = station_window_flags(rounds, window, flag)
+        for key, n in counts.items():
+            if n < need:
+                continue
+            task, station = key
+            findings.append({
+                "message": (
+                    f"station {station} (task {task}) sent anomalous "
+                    f"updates in {n} of the last {n_rounds} recorded "
+                    f"rounds — worst: {worst[key][1]}"
+                ),
+                "labels": {"task": task, "station": station},
+            })
+    return findings
+
+
+def _check_model_divergence(ctx: RuleContext) -> list[dict[str, Any]]:
+    need = int(ctx.config["divergence_rounds"])
+    min_growth = float(ctx.config["divergence_min_growth_pct"])
+    findings = []
+    for item in ctx.feed_items("learning_tasks"):
+        norms = [
+            v for v in (item.get("recent_norms") or ())
+            if isinstance(v, (int, float))
+        ][-(need + 1):]
+        if len(norms) < need + 1 or norms[0] <= 0:
+            continue
+        # strictly increasing over the window AND real growth overall —
+        # round-to-round wobble is normal, a monotone climb is not
+        if not all(b > a for a, b in zip(norms, norms[1:])):
+            continue
+        growth_pct = 100.0 * (norms[-1] - norms[0]) / norms[0]
+        if growth_pct < min_growth:
+            continue
+        findings.append({
+            "message": (
+                f"task {item.get('task')}: global update norm grew "
+                f"monotonically over the last {need} recorded rounds "
+                f"({norms[0]:.3g} -> {norms[-1]:.3g}, "
+                f"+{growth_pct:.1f}%) — the model is diverging"
+            ),
+            "labels": {"task": item.get("task")},
+        })
+    return findings
+
+
+def _check_non_convergence(ctx: RuleContext) -> list[dict[str, Any]]:
+    budget = int(ctx.config["non_convergence_rounds"])
+    window = int(ctx.config["non_convergence_window"])
+    min_decay = float(ctx.config["non_convergence_decay_pct"])
+    converged = float(ctx.config["non_convergence_converged_ratio"])
+    findings = []
+    for item in ctx.feed_items("learning_tasks"):
+        rounds = item.get("rounds") or 0
+        if rounds < budget:
+            continue
+        norms = [
+            v for v in (item.get("recent_norms") or ())
+            if isinstance(v, (int, float))
+        ][-window:]
+        if len(norms) < 2 or norms[0] <= 0:
+            continue
+        peak = item.get("peak_norm") or 0.0
+        # a CONVERGED run plateaus near zero relative to its peak —
+        # plateau-at-the-bottom is success, not a stall
+        if peak > 0 and norms[-1] <= converged * peak:
+            continue
+        decay_pct = 100.0 * (norms[0] - norms[-1]) / norms[0]
+        if decay_pct >= min_decay:
+            continue
+        # a NEGATIVE decay is the norm growing non-monotonically —
+        # model_divergence's strictly-monotone check stays quiet, but
+        # telling the operator "decay stalled, fell only -80%" would
+        # misdiagnose a blow-up as a stall and point at the wrong runbook
+        if decay_pct < 0:
+            trend = (
+                f"the global update norm ROSE {-decay_pct:.1f}% (non-"
+                "monotonically — check model_divergence and the lr)"
+            )
+        else:
+            trend = (
+                "norm decay stalled — the global update norm fell only "
+                f"{decay_pct:.1f}%"
+            )
+        findings.append({
+            "message": (
+                f"task {item.get('task')}: {trend} over the "
+                f"last {len(norms)} recorded rounds "
+                f"({norms[0]:.3g} -> {norms[-1]:.3g}) after {rounds} "
+                f"rounds (budget {budget})"
+            ),
+            "labels": {"task": item.get("task")},
+        })
+    return findings
 
 
 def _check_queue_buildup(ctx: RuleContext) -> list[dict[str, Any]]:
@@ -430,6 +614,64 @@ def default_rules() -> list[AlertRule]:
             check=_check_straggler_station,
         ),
         AlertRule(
+            name="anomalous_station",
+            severity="warning",
+            summary=(
+                "A station's updates are statistical outliers in several "
+                "recent rounds — cosine to the pooled update below "
+                "threshold (label flip / poisoning / diverging local "
+                "training) or update norm a multiple of the station "
+                "median (scaling / exploding gradients)."
+            ),
+            runbook=(
+                "GET /api/rounds/<task_id> for the per-station "
+                "trajectory (doctor's learning digest renders the same "
+                "table from a dump); inspect the station's data/labels, "
+                "then drop it from the next task's organizations or mask "
+                "it — the pooled update already nan-isolates zero-weight "
+                "stations."
+            ),
+            metrics=(),
+            check=_check_anomalous_station,
+        ),
+        AlertRule(
+            name="model_divergence",
+            severity="critical",
+            summary=(
+                "The global update norm is growing monotonically across "
+                "recorded rounds — the model is diverging (learning rate "
+                "too high, poisoned aggregate, or numerical blow-up), "
+                "and every further round makes it worse."
+            ),
+            runbook=(
+                "stop the run (kill_task), check /api/rounds for which "
+                "round the norm took off and whether anomalous_station "
+                "names a culprit; resume from the last good checkpoint "
+                "with a lower local_lr/server lr."
+            ),
+            metrics=(),
+            check=_check_model_divergence,
+        ),
+        AlertRule(
+            name="non_convergence",
+            severity="warning",
+            summary=(
+                "The global update norm stopped decaying past the round "
+                "budget — training is burning rounds without progress "
+                "(lr too low/high, compression too aggressive, or the "
+                "task is mis-specified)."
+            ),
+            runbook=(
+                "read the trajectory at /api/rounds/<task_id> (trend "
+                "first: is it flat or oscillating?), check ef_mass_growth "
+                "and anomalous_station beside it, then adjust lr / "
+                "topk_ratio or re-examine the data split — "
+                "docs/OPERATOR_GUIDE.md 'the model isn't converging'."
+            ),
+            metrics=(),
+            check=_check_non_convergence,
+        ),
+        AlertRule(
             name="queue_buildup",
             severity="warning",
             summary=(
@@ -590,6 +832,17 @@ class Watchdog:
             "straggler_rounds": 3,
             "straggler_ratio": 3.0,
             "straggler_window": 8,
+            # learning plane (runtime.learning feed)
+            "anomaly_cos_threshold": 0.2,
+            "anomaly_norm_factor": 4.0,
+            "anomaly_rounds": 3,
+            "anomaly_window": 8,
+            "divergence_rounds": 4,
+            "divergence_min_growth_pct": 10.0,
+            "non_convergence_rounds": 30,
+            "non_convergence_window": 16,
+            "non_convergence_decay_pct": 5.0,
+            "non_convergence_converged_ratio": 0.05,
             "ef_growth_evals": 4,
             "recompile_storm_retraces": 3,
             "recompile_storm_window": 4,

@@ -245,6 +245,42 @@ def register_resources(srv: "ServerApp") -> None:
             "rules": RULE_CATALOG,
         }
 
+    @app.route("/api/rounds")
+    def rounds_index(req: Request):
+        """Learning-plane index: every task the process learning registry
+        tracks, with its convergence summary (rounds, first/last/peak
+        pooled-update norm, decay, per-station contribution table).
+        Unauthenticated like /api/alerts — it carries aggregate update
+        STATISTICS (norms, cosines), never payloads or principals."""
+        from vantage6_tpu.runtime.learning import LEARNING
+
+        return {"tasks": LEARNING.summaries()}
+
+    @app.route("/api/rounds/<int:id>")
+    def rounds_for_task(req: Request, id: int):
+        """One task's learning-plane round history: per-round loss, the
+        pooled update norm (convergence trajectory), and per-station
+        norms/cosines/EF mass — what the `anomalous_station` /
+        `non_convergence` / `model_divergence` watchdog rules read, served
+        raw so an operator (or the doctor) can see WHY an alert fired.
+        404 for tasks the learning registry never tracked (host-mode
+        tasks without an engine/aggregation recording)."""
+        from vantage6_tpu.runtime.learning import LEARNING
+
+        hist = LEARNING.get(id)
+        if hist is None:
+            raise HTTPError(
+                404,
+                f"no learning-plane history for task {id} (not an "
+                "engine/aggregated task, or evicted)",
+            )
+        limit = min(512, max(1, req.int_arg("limit", 128)))
+        return {
+            "task_id": id,
+            "summary": hist.summary(),
+            "rounds": hist.rounds(limit=limit),
+        }
+
     @app.route("/api/debug/dump", methods=("POST",))
     def debug_dump(req: Request):
         """Dump this server process's flight recorder to a JSONL bundle

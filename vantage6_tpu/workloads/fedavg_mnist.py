@@ -56,6 +56,7 @@ def make_engine(
     shard_server_update: bool = False,
     comm_dtype: Any = None,
     compressor: Any = None,
+    learning_stats: bool = True,
 ) -> FedAvg:
     return FedAvg(
         mesh,
@@ -68,6 +69,10 @@ def make_engine(
             shard_server_update=shard_server_update,
             comm_dtype=comm_dtype,
             compressor=compressor,
+            # False in the pure-throughput bench legs: a timed round must
+            # not compute stats it immediately discards (and the baseline
+            # trend stays comparable to pre-learning-plane rounds)
+            learning_stats=learning_stats,
         ),
     )
 
@@ -103,7 +108,7 @@ def train_fedavg(
     sx, sy, counts = make_federated_data(mesh.n_stations, mesh=mesh)
     key = jax.random.key(seed)
     params = init_params(jax.random.fold_in(key, 1))
-    params, _, losses = engine.run_rounds(
+    params, _, losses, _ = engine.run_rounds(
         params, sx, sy, counts, jax.random.fold_in(key, 2), n_rounds
     )
     return params, losses
