@@ -114,6 +114,20 @@ CONTROL_TIMEOUT_S = 420
 CP_DAEMONS = 8
 CP_TASKS = 40
 CP_WIDTH = 2          # organizations targeted per task
+# control_plane_scale leg (horizontal scale-out PR): 1 vs 2 STATELESS
+# server replica PROCESSES over one shared sqlite+wal store, same daemon
+# fleet (in the worker process) + same task load on each arm. The client
+# pipelines CPS_TASKS tiny partials (create all, then collect), daemons
+# spread their primary api_url round-robin across the replicas and only
+# fail over on connection errors. Reports tasks/sec per arm, the 1->2
+# speedup, a zero-double-dispatch count (activation CAS losers + won-vs-
+# expected mismatch), cross-arm results parity, and per-replica request
+# attribution read off each replica's own V6T_TRACE_FILE span sink.
+CPSCALE_TIMEOUT_S = 900
+CPS_REPLICAS = 2      # scaled arm size (arms are 1 vs CPS_REPLICAS)
+CPS_DAEMONS = 8
+CPS_TASKS = 1000
+CPS_WIDTH = 1         # one org per task: runs == tasks, pure throughput
 # observability leg (tracing + telemetry PR): the control_plane mini
 # topology run with distributed tracing OFF vs ON (same transport, same
 # tasks), arms ALTERNATED to decorrelate machine noise and best-of per
@@ -1025,6 +1039,292 @@ def worker_controlplane() -> None:
         # identical inputs across arms
         "results_parity": bool(
             legacy["parity_ok"] and fast["parity_ok"] and cross_parity
+        ),
+    }))
+
+
+def worker_replica() -> None:
+    """control_plane_scale child: ONE stateless server replica process over
+    the shared store named by V6T_CPS_URI. Prints a {"url", "replica_id"}
+    line once serving, then blocks until its stdin closes — the parent's
+    shutdown signal (portable, no signal handling needed)."""
+    _worker_setup()
+    from vantage6_tpu.server.app import ServerApp
+
+    srv = ServerApp(
+        uri=os.environ["V6T_CPS_URI"],
+        jwt_secret=os.environ["V6T_CPS_SECRET"],
+    )
+    if os.environ.get("V6T_CPS_ENSURE_ROOT") == "1":
+        srv.ensure_root(password=os.environ["V6T_CPS_ROOT_PW"])
+    http = srv.serve(port=0, background=True)
+    print(json.dumps(
+        {"url": http.url, "replica_id": srv.replica_id}
+    ), flush=True)
+    try:
+        sys.stdin.read()
+    finally:
+        http.stop()
+        srv.close()
+
+
+def worker_cpscale() -> None:
+    """control_plane_scale leg: horizontal scale-out of the control plane.
+
+    1 vs CPS_REPLICAS stateless server replicas — SEPARATE OS processes
+    (spawned via `--worker replica`) sharing ONE sqlite+wal store — serve
+    the same fleet of CPS_DAEMONS node daemons and the same pipelined load
+    of CPS_TASKS tiny pandas partials. Daemons take comma-separated
+    api_url lists with their PRIMARY round-robined across replicas (the
+    list is failover, not load-balancing), so steady-state REST traffic
+    splits evenly. Acceptance: >= 1.6x tasks/sec at 2 replicas, ZERO
+    double-dispatch (every run's activation CAS won exactly once — the
+    store-level claim guard, counted at the daemons), cross-arm results
+    parity, and per-replica request attribution visible in each replica's
+    own trace file (summarize()['replicas'])."""
+    _worker_setup()
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from vantage6_tpu.client import UserClient
+    from vantage6_tpu.common.enums import TaskStatus
+    from vantage6_tpu.node.daemon import NodeDaemon
+    from vantage6_tpu.runtime.tracing import read_spans, summarize
+
+    n_replicas = int(os.environ.get("BENCH_CPS_REPLICAS", str(CPS_REPLICAS)))
+    n_daemons = int(os.environ.get("BENCH_CPS_DAEMONS", str(CPS_DAEMONS)))
+    n_tasks = int(os.environ.get("BENCH_CPS_TASKS", str(CPS_TASKS)))
+    image, module = "v6-average-py", "vantage6_tpu.workloads.average"
+    root_pw = "cps-rootpass-123"
+
+    tmp = tempfile.mkdtemp(prefix="v6t-cps-bench-")
+    rng = np.random.default_rng(11)
+    csvs = []
+    for i in range(n_daemons):
+        path = os.path.join(tmp, f"s{i:02d}.csv")
+        pd.DataFrame(
+            {"age": rng.uniform(20, 80, 32).round(1)}
+        ).to_csv(path, index=False)
+        csvs.append(path)
+
+    def spawn_replica(uri: str, rid: str, ensure_root: bool,
+                      trace_file: str):
+        env = dict(os.environ)
+        env.update({
+            "V6T_CPS_URI": uri,
+            "V6T_CPS_SECRET": "cps-shared-jwt-secret",
+            "V6T_CPS_ENSURE_ROOT": "1" if ensure_root else "0",
+            "V6T_CPS_ROOT_PW": root_pw,
+            "V6T_REPLICA_ID": rid,
+            "V6T_TRACE_FILE": trace_file,
+            "BENCH_FORCE_CPU": "1",
+        })
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", "replica"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env,
+        )
+        line = proc.stdout.readline()
+        try:
+            info = json.loads(line)
+        except json.JSONDecodeError:
+            proc.kill()
+            raise RuntimeError(
+                f"replica {rid} failed to boot: {line!r} / "
+                f"{proc.stderr.read()[-2000:]}"
+            )
+        return proc, info["url"]
+
+    def arm(n_reps: int) -> dict:
+        # fresh store per arm: the 1-replica arm must not inherit the
+        # scaled arm's backlog (or vice versa)
+        uri = "sqlite+wal:///" + os.path.join(tmp, f"cp-{n_reps}.db")
+        traces = [
+            os.path.join(tmp, f"trace-{n_reps}rep-r{r}.jsonl")
+            for r in range(n_reps)
+        ]
+        procs, urls = [], []
+        for r in range(n_reps):
+            proc, url = spawn_replica(
+                uri, f"replica-{r}", ensure_root=(r == 0),
+                trace_file=traces[r],
+            )
+            procs.append(proc)
+            urls.append(url)
+        daemons = []
+        try:
+            client = UserClient(urls[0])
+            client.authenticate("root", root_pw)
+            orgs = [
+                client.organization.create(name=f"cps{i:02d}")
+                for i in range(n_daemons)
+            ]
+            collab = client.collaboration.create(
+                name="cps", organization_ids=[o["id"] for o in orgs]
+            )
+            for i, org in enumerate(orgs):
+                ni = client.node.create(
+                    organization_id=org["id"],
+                    collaboration_id=collab["id"],
+                )
+                # primary replica round-robined; the rest are failover
+                ordered = urls[i % n_reps:] + urls[:i % n_reps]
+                d = NodeDaemon(
+                    api_url=",".join(ordered),
+                    api_key=ni["api_key"],
+                    algorithms={image: module},
+                    databases=[
+                        {"label": "default", "type": "csv",
+                         "uri": csvs[i]}
+                    ],
+                    mode="inline",
+                    poll_interval=0.25,
+                    transport="batched",
+                    event_wait=2.0,
+                )
+                d.start()
+                daemons.append(d)
+            org_ids = [o["id"] for o in orgs]
+            # concurrent submitters — users behind a dumb round-robin LB.
+            # Each thread owns its clients (UserClient is not built for
+            # cross-thread sharing): tasks are CREATED on one replica and
+            # AWAITED through the next one over, so results reported via
+            # any replica must become visible — and wake long-polls —
+            # through every other (the shared-store event bus at work).
+            from concurrent.futures import ThreadPoolExecutor
+
+            n_threads = int(os.environ.get("BENCH_CPS_CLIENTS", "8"))
+            thread_clients = []
+            for k in range(n_threads):
+                a = UserClient(urls[k % n_reps])
+                a.authenticate("root", root_pw)
+                if n_reps == 1:
+                    thread_clients.append((a, a))
+                    continue
+                b = UserClient(urls[(k + 1) % n_reps])
+                b.authenticate("root", root_pw)
+                thread_clients.append((a, b))
+
+            results: list = [None] * n_tasks
+            parity_per_thread = [True] * n_threads
+
+            def drive(k: int) -> None:
+                create_cl, wait_cl = thread_clients[k]
+                ok = True
+                for i in range(k, n_tasks, n_threads):
+                    t = create_cl.task.create(
+                        collaboration=collab["id"],
+                        organizations=[
+                            org_ids[(i + j) % n_daemons]
+                            for j in range(CPS_WIDTH)
+                        ],
+                        image=image,
+                        input_={"method": "partial_average",
+                                "kwargs": {"column": "age"}},
+                    )
+                    results[i] = wait_cl.wait_for_results(
+                        t["id"], interval=0.25, timeout=300.0
+                    )
+                    runs = wait_cl.run.from_task(t["id"])
+                    ok &= len(runs) == CPS_WIDTH
+                    ok &= all(
+                        TaskStatus(r["status"]) == TaskStatus.COMPLETED
+                        for r in runs
+                    )
+                parity_per_thread[k] = ok
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(n_threads) as ex:
+                list(ex.map(drive, range(n_threads)))
+            total_s = time.perf_counter() - t0
+            parity = all(parity_per_thread) and None not in results
+            won = sum(d.activations_won for d in daemons)
+            lost = sum(d.activations_lost for d in daemons)
+            # ground-truth per-replica request counts off each replica's
+            # own /api/metrics (spans only cover TRACED hops; the counter
+            # sees every request including daemon claim/report polls)
+            import urllib.request as _ur
+
+            served = {}
+            for u in urls:
+                try:
+                    body = _ur.urlopen(
+                        u + "/api/metrics", timeout=10
+                    ).read().decode()
+                except Exception:
+                    body = ""
+                n_req = 0
+                for ln in body.splitlines():
+                    if ln.startswith("v6t_http_requests_total"):
+                        n_req = int(float(ln.split()[-1]))
+                served[u] = n_req
+        finally:
+            for d in daemons:
+                d.stop()
+            for p in procs:
+                try:
+                    p.stdin.close()
+                    p.wait(timeout=30)
+                except Exception:
+                    p.kill()
+        # span-level attribution off each replica's own sink: only TRACED
+        # hops (client task ops, unbatched reports) appear here — the
+        # trace_view per-replica table the operators read
+        spans = []
+        for path in traces:
+            try:
+                spans.extend(read_spans(path))
+            except OSError:
+                pass
+        rep_summary = (summarize(spans) or {}).get("replicas") or {}
+        expected = n_tasks * CPS_WIDTH
+        return {
+            "n_replicas": n_reps,
+            "tasks_per_sec": round(n_tasks / total_s, 3),
+            "total_s": round(total_s, 3),
+            # double-dispatch = a run activated by 2 daemons (CAS loser
+            # seen) OR won a different number of times than runs exist
+            "activations_won": int(won),
+            "activations_lost": int(lost),
+            "double_dispatch": int(lost + abs(won - expected)),
+            "parity_ok": bool(parity),
+            "requests_per_replica": [served[u] for u in urls],
+            "traced_spans_per_replica": {
+                rid: row["count"]
+                for rid, row in (
+                    rep_summary.get("by_replica") or {}
+                ).items()
+            },
+            "results": results,
+        }
+
+    one = arm(1)
+    many = arm(n_replicas)
+    cross_parity = one.pop("results") == many.pop("results")
+    print(json.dumps({
+        "n_daemons": n_daemons,
+        "n_tasks": n_tasks,
+        "width": CPS_WIDTH,
+        "single": one,
+        "scaled": many,
+        # distinct from the control_plane leg's speedup_tasks_per_sec so
+        # bench_trend's flattener never conflates the two headline rows
+        "scaleout_speedup_tasks_per_sec": round(
+            many["tasks_per_sec"] / one["tasks_per_sec"], 2
+        ) if one["tasks_per_sec"] > 0 else None,
+        "double_dispatch": int(
+            one["double_dispatch"] + many["double_dispatch"]
+        ),
+        # every replica in the scaled arm actually served real traffic
+        "all_replicas_served": bool(
+            len(many["requests_per_replica"]) == n_replicas
+            and min(many["requests_per_replica"]) > 0
+        ),
+        "results_parity": bool(
+            one["parity_ok"] and many["parity_ok"] and cross_parity
         ),
     }))
 
@@ -2420,6 +2720,22 @@ def main() -> None:
     legs_done.append(leg_marker("control_plane", cp, cp_diag))
     emit()
 
+    # ---- control-plane horizontal scale-out (1 vs N replicas) ---------
+    # CPU by design: scheduler/transport contention under a shared WAL
+    # store — no tensor compute anywhere in the leg.
+    cps, cps_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        cps, cps_diag = _run_worker(
+            "cpscale", force_cpu=True,
+            timeout_s=leg_timeout(CPSCALE_TIMEOUT_S),
+        )
+    if cps is not None:
+        out["control_plane_scale"] = cps
+    else:
+        out["control_plane_scale_error"] = cps_diag
+    legs_done.append(leg_marker("control_plane_scale", cps, cps_diag))
+    emit()
+
     # ---- observability guardrail (tracing on vs off) -------------------
     # CPU by design: pure control-plane latency again, now with the span
     # instrumentation armed — the leg exists to keep tracing overhead
@@ -2614,6 +2930,8 @@ if __name__ == "__main__":
          "baseline": worker_baseline,
          "hostparallel": worker_hostparallel,
          "controlplane": worker_controlplane,
+         "cpscale": worker_cpscale,
+         "replica": worker_replica,
          "observability": worker_observability,
          "wireformat": worker_wireformat,
          "compression": worker_compression,

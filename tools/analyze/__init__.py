@@ -1,6 +1,6 @@
 """v6lint — AST-based invariant analyzer for vantage6-tpu.
 
-Four passes over the package's ASTs (no package import, no jax import —
+Five passes over the package's ASTs (no package import, no jax import —
 pure parsing, so a full run stays well under the 10 s CI budget):
 
 1. **lock discipline** (``locks.py``) — blocking calls under locks,
@@ -12,6 +12,10 @@ pure parsing, so a full run stays well under the 10 s CI budget):
    ``@app.route`` tables and REST call sites; wire-format tag constants.
 4. **telemetry coherence** (``telemetry.py``) — every instantiated metric
    declared in ``KNOWN_METRICS``, every declared metric alive.
+5. **cross-replica state safety** (``replica.py``) — in-process mutable
+   state in the server package must carry a ``# replica-local:``
+   justification: with N replicas over one shared store, unannotated
+   process-memory state silently diverges across replicas.
 
 Pre-existing, *justified* findings live in ``baseline.toml`` (one reason
 per waiver); anything new fails CI via ``tools/check_collect.py``. See
@@ -43,6 +47,7 @@ from .model import (
     save_baseline,
     walk_package,
 )
+from .replica import run_replica_pass
 from .telemetry import run_telemetry_pass
 from .tracers import run_tracer_pass
 
@@ -66,6 +71,7 @@ _PASSES = (
     run_tracer_pass,
     run_contract_pass,
     run_telemetry_pass,
+    run_replica_pass,
 )
 
 

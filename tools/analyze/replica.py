@@ -1,0 +1,133 @@
+"""v6lint pass 5 — cross-replica state safety.
+
+The control plane runs as N stateless replicas over one shared store
+(docs/control_plane.md): any state a ``vantage6_tpu/server/`` module
+keeps in PROCESS memory exists once per replica and silently diverges —
+a cache one replica invalidates and another keeps serving, an event
+buffer only one replica's clients see, a counter that double-counts.
+
+- ``cross-replica-unsafe-state``: a module-level or ``__init__``-assigned
+  mutable container (dict/list/set/deque/defaultdict/Counter/
+  itertools.count/comprehension) in a server module that carries no
+  ``# replica-local:`` annotation. The annotation is the reviewed claim
+  that per-replica divergence is safe (a code-derived constant registry,
+  a bus-invalidated cache, a per-replica rate limiter) and SAYS WHY —
+  state that cannot justify the annotation belongs in the shared store
+  or on the pubsub bus.
+
+The annotation exempts the assignment when it appears on the same line
+or the line directly above. ``db.py`` is out of scope: it IS the shared
+store implementation — its in-process state is the store handle itself.
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import Index
+from .model import Finding, SourceFile
+
+_SCOPE_PREFIX = "vantage6_tpu/server/"
+_EXEMPT = {"vantage6_tpu/server/db.py"}
+_ANNOT = "# replica-local:"
+_MUT_CALLS = {
+    "dict", "list", "set", "defaultdict", "deque", "Counter",
+    "OrderedDict", "count",
+}
+
+
+def _is_mutable_ctor(node: ast.AST) -> bool:
+    if isinstance(
+        node,
+        (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp),
+    ):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute)
+            else None
+        )
+        return name in _MUT_CALLS
+    return False
+
+
+def _annotated(src: SourceFile, line: int) -> bool:
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(src.lines) and _ANNOT in src.lines[ln - 1]:
+            return True
+    return False
+
+
+def _assign_parts(
+    stmt: ast.stmt,
+) -> tuple[ast.expr | None, ast.expr | None]:
+    """(target, value) for single-target Assign/AnnAssign, else (None, None)."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        return stmt.targets[0], stmt.value
+    if isinstance(stmt, ast.AnnAssign):
+        return stmt.target, stmt.value
+    return None, None
+
+
+def run_replica_pass(index: Index) -> list[Finding]:
+    findings: list[Finding] = []
+    for mi in index.modules.values():
+        rel = mi.src.rel
+        if not rel.startswith(_SCOPE_PREFIX) or rel in _EXEMPT:
+            continue
+        # module-level mutable assignments
+        for stmt in mi.src.tree.body:
+            target, value = _assign_parts(stmt)
+            if (
+                isinstance(target, ast.Name)
+                and value is not None
+                and _is_mutable_ctor(value)
+                and not _annotated(mi.src, stmt.lineno)
+            ):
+                findings.append(
+                    Finding(
+                        "cross-replica-unsafe-state", rel, stmt.lineno,
+                        f"module-level mutable {target.id} lives once per "
+                        "replica and diverges across N server replicas — "
+                        "move it into the shared store / pubsub bus, or "
+                        "annotate '# replica-local: <why divergence is "
+                        "safe>'",
+                        context=target.id,
+                    )
+                )
+        # instance state minted in __init__
+        for stmt in mi.src.tree.body:
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            for item in stmt.body:
+                if not (
+                    isinstance(item, ast.FunctionDef)
+                    and item.name == "__init__"
+                ):
+                    continue
+                for sub in ast.walk(item):
+                    target, value = _assign_parts(sub)
+                    if not (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and value is not None
+                        and _is_mutable_ctor(value)
+                    ):
+                        continue
+                    if _annotated(mi.src, sub.lineno):
+                        continue
+                    findings.append(
+                        Finding(
+                            "cross-replica-unsafe-state", rel, sub.lineno,
+                            f"{stmt.name}.{target.attr} is in-process "
+                            "mutable state minted per replica — N replicas "
+                            "over one shared store each hold their own "
+                            "copy; move it into the store / pubsub bus, or "
+                            "annotate '# replica-local: <why divergence is "
+                            "safe>'",
+                            context=f"{stmt.name}.{target.attr}",
+                        )
+                    )
+    return findings

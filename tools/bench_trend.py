@@ -67,6 +67,15 @@ HEADLINES: list[tuple[str, str, str]] = [
     ("learning_overhead_pct", "lower", "observability"),
     ("anomaly_detect_s", "lower", "observability"),
     ("wire_reduction_ratio", "higher", "compression"),
+    # horizontal control-plane scale-out (N replicas over one shared
+    # store): 1 -> 2 replica throughput ratio; acceptance floor is 1.6x
+    ("scaleout_speedup_tasks_per_sec", "higher", "control_plane_scale"),
+    # MXU utilization headlines: fraction of the v5e bf16 peak the FedAvg
+    # round and the transformer step actually achieve on-chip — the
+    # paper's core efficiency claim, tracked per round so a kernel or
+    # sharding regression shows as a falling ratio, not just a slower leg
+    ("mfu_vs_v5e_bf16_peak", "higher", "spmd"),
+    ("transformer_mfu_vs_v5e_bf16_peak", "higher", "transformer"),
 ]
 
 _NUM_RE = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"

@@ -32,6 +32,13 @@ It ALSO audits the TELEMETRY registry's declared metric surface
 (`common/telemetry.py` KNOWN_METRICS): every name unique, snake_case, and
 typed — a duplicate would silently shadow a series in `GET /api/metrics`.
 
+It ALSO audits the STORAGE BACKEND surface (server/db.py,
+docs/control_plane.md): raw `import sqlite3` contained to the backend
+module (plus the node-side station-data loader), the `BACKENDS` scheme
+registry coherent, and the cross-replica cache-invalidation bus agreeing
+end to end — the entity names resources.py emits are the ones
+app.py's drain applies.
+
 It ALSO runs the full v6lint static analyzer (`python -m tools.analyze
 --json`, docs/static_analysis.md): lock discipline, JAX tracer hygiene,
 route/method contracts and telemetry coherence over the whole package.
@@ -354,6 +361,141 @@ def check_alert_rules() -> list[str]:
     return problems
 
 
+def check_storage_backend() -> list[str]:
+    """Audit the shared-store surface (server/db.py, server/pubsub.py,
+    docs/control_plane.md "running N replicas"):
+
+    - ``import sqlite3`` appears ONLY in ``server/db.py`` — every other
+      module must go through the ``StorageBackend`` registry, or a
+      replica-unsafe raw connection sneaks past the WAL/busy-retry
+      discipline;
+    - the ``BACKENDS`` registry is coherent: both shipped schemes
+      (``sqlite``, ``sqlite+wal``) registered, every entry subclassing
+      ``Database`` with ``KIND`` matching its key;
+    - the cache-invalidation bus agrees end to end: ``CACHE_INVALIDATE``
+      and ``REPLICA_ROOM`` exist in ``server/events.py``, the emit side
+      (``resources.py _invalidate``) and the apply side (``app.py
+      drain_invalidations``) both reference the constant, and every
+      entity literal the emitter publishes is one the drain handles —
+      an unhandled entity would invalidate locally but stay stale on
+      every OTHER replica forever.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    import ast
+
+    # -- raw sqlite3 containment ------------------------------------
+    # allowed: db.py IS the backend; data_loading.py is the NODE-side
+    # loader for a station's own sqlite data file — user data, not the
+    # control-plane store, so the WAL/CAS discipline does not apply
+    allowed = {
+        os.path.join("vantage6_tpu", "server", "db.py"),
+        os.path.join("vantage6_tpu", "algorithm", "data_loading.py"),
+    }
+    pkg_root = os.path.join(_REPO_ROOT, "vantage6_tpu")
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, _REPO_ROOT)
+            if rel in allowed:
+                continue
+            try:
+                tree = ast.parse(open(path).read())
+            except (OSError, SyntaxError) as e:
+                problems.append(f"cannot parse {rel}: {e}")
+                continue
+            for node in ast.walk(tree):
+                hit = (
+                    isinstance(node, ast.Import)
+                    and any(a.name.split(".")[0] == "sqlite3"
+                            for a in node.names)
+                ) or (
+                    isinstance(node, ast.ImportFrom)
+                    and (node.module or "").split(".")[0] == "sqlite3"
+                )
+                if hit:
+                    problems.append(
+                        f"{rel}:{node.lineno}: raw `import sqlite3` outside "
+                        "server/db.py — go through the StorageBackend "
+                        "registry (open_backend) so WAL mode and busy-retry "
+                        "apply"
+                    )
+
+    # -- backend registry coherence ----------------------------------
+    try:
+        from vantage6_tpu.server.db import BACKENDS, Database
+    except Exception as e:  # pragma: no cover - environment broken
+        return problems + [f"cannot import the backend registry: {e!r}"]
+    for scheme in ("sqlite", "sqlite+wal"):
+        if scheme not in BACKENDS:
+            problems.append(
+                f"backend scheme {scheme!r} missing from BACKENDS "
+                "(server/db.py) — `{scheme}:///` URIs stopped resolving"
+            )
+    for scheme, cls in BACKENDS.items():
+        if not (isinstance(cls, type) and issubclass(cls, Database)):
+            problems.append(
+                f"BACKENDS[{scheme!r}] is not a Database subclass"
+            )
+        elif cls.KIND != scheme:
+            problems.append(
+                f"BACKENDS[{scheme!r}] registers {cls.__name__} whose KIND "
+                f"is {cls.KIND!r} — registry key and class disagree"
+            )
+
+    # -- invalidation bus: emit side <-> apply side -------------------
+    try:
+        from vantage6_tpu.server import events as ev_mod
+
+        for const in ("CACHE_INVALIDATE", "REPLICA_ROOM"):
+            if not isinstance(getattr(ev_mod, const, None), str):
+                problems.append(
+                    f"server/events.py no longer defines {const} — the "
+                    "cross-replica invalidation bus lost its vocabulary"
+                )
+    except Exception as e:  # pragma: no cover - environment broken
+        return problems + [f"cannot import server/events.py: {e!r}"]
+    res_path = os.path.join(
+        _REPO_ROOT, "vantage6_tpu", "server", "resources.py"
+    )
+    app_path = os.path.join(_REPO_ROOT, "vantage6_tpu", "server", "app.py")
+    try:
+        res_src = open(res_path).read()
+        app_src = open(app_path).read()
+    except OSError as e:
+        return problems + [f"cannot read the bus endpoints: {e}"]
+    for src, rel, role in (
+        (res_src, "server/resources.py", "emit"),
+        (app_src, "server/app.py", "apply"),
+    ):
+        if "CACHE_INVALIDATE" not in src:
+            problems.append(
+                f"{rel} never references CACHE_INVALIDATE — the {role} "
+                "side of the cross-replica invalidation bus is gone"
+            )
+    emitted = set(re.findall(r'_invalidate\(\s*srv,\s*"(\w+)"', res_src))
+    m = re.search(
+        r"def drain_invalidations\(.*?(?=\n    def )", app_src, re.S
+    )
+    handled = set(re.findall(r'"(\w+)"', m.group(0))) if m else set()
+    if not m:
+        problems.append(
+            "server/app.py lost drain_invalidations() — other replicas' "
+            "invalidation events are never applied"
+        )
+    for entity in sorted(emitted - handled):
+        problems.append(
+            f"resources.py emits cache invalidation for entity "
+            f"{entity!r} that app.py drain_invalidations() does not "
+            "handle — every other replica would serve stale "
+            f"{entity} state until TTL"
+        )
+    return problems
+
+
 def note_bench_trend() -> None:
     """ADVISORY (never fails the gate): run tools/bench_trend.py and
     surface perf drift across the committed BENCH_r*.json rounds. Bench
@@ -540,6 +682,17 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    backend_problems = check_storage_backend()
+    if backend_problems:
+        sys.stderr.write(
+            "STORAGE BACKEND DRIFT: the shared-store registry, raw-sqlite3 "
+            "containment, or the cache-invalidation bus broke "
+            "(docs/control_plane.md):\n"
+        )
+        for p in backend_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     note_bench_trend()
 
     lint_problems = check_static_analysis()
@@ -597,6 +750,8 @@ def main(argv: list[str]) -> int:
               "declared <-> emitted, profile route audited")
         print("learning-plane audit ok: v6t_round_*/v6t_station_* declared "
               "<-> emitted, rules cataloged, rounds route audited")
+        print("storage-backend audit ok: sqlite3 contained to db.py, "
+              "BACKENDS coherent, invalidation bus emit <-> apply agree")
         print("static analysis ok: v6lint found no unwaived violations")
         print(f"collection clean: {counted} tests collected")
         return 0

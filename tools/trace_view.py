@@ -133,6 +133,19 @@ def render_table(summary: dict) -> str:
                 )
             if t.get("last_loss") is not None:
                 lines.append(f"    last loss: {t['last_loss']:.4g}")
+    replicas = summary.get("replicas")
+    if replicas:
+        lines += [
+            "",
+            "control plane replicas (server spans by replica attr):",
+        ]
+        for rid, row in (replicas.get("by_replica") or {}).items():
+            lines.append(
+                f"  {rid}: {row['count']} request(s)"
+                f"  {row['share_pct']:.1f}% share"
+                f"  {row['total_ms']:.3f} ms total"
+                + (f"  {row['errors']} error(s)" if row["errors"] else "")
+            )
     return "\n".join(lines)
 
 

@@ -237,7 +237,19 @@ class NodeDaemon:
                 local_device_ids=device_engine.get("local_device_ids"),
                 auto=bool(device_engine.get("auto", False)),
             )
-        self.api_url = api_url.rstrip("/")
+        # replica-aware transport: `api_url` may be a comma-separated list
+        # of server replica URLs (N stateless replicas over one shared
+        # store — docs/control_plane.md). The daemon talks to ONE at a
+        # time (api_urls[0] initially) and rotates to the next on a
+        # connection-level failure; any replica serves any request, so a
+        # rotation is invisible above the transport.
+        self.api_urls = [
+            u.strip().rstrip("/") for u in api_url.split(",") if u.strip()
+        ]
+        if not self.api_urls:
+            raise ValueError("api_url must name at least one server URL")
+        self._url_index = 0
+        self.api_url = self.api_urls[0]
         self.api_key = api_key
         self.poll_interval = poll_interval
         self.sync_interval = sync_interval
@@ -252,6 +264,9 @@ class NodeDaemon:
         )
         self.last_ping_at: float | None = None
         self.ping_failures = 0
+        # double-dispatch ledger (see _execute_run's activation CAS)
+        self.activations_won = 0
+        self.activations_lost = 0
         self.transport = transport
         self.event_wait = max(0.0, float(event_wait))
         # None = capability unknown; False = server lacks the batch
@@ -387,8 +402,37 @@ class NodeDaemon:
         params: dict[str, Any] | None = None,
         timeout: float | None = None,
     ) -> Any:
-        return self._rest.request(
-            method, endpoint, json_body, params, timeout=timeout
+        """One control-plane request, replica-aware: a CONNECTION-level
+        failure (socket refused/reset/timed out — the server process is
+        gone) rotates to the next replica URL and retries, once per
+        configured replica. HTTP-level errors (RestError) pass through
+        untouched: the server answered, the replica is fine."""
+        last_exc: Exception | None = None
+        for _ in range(len(self.api_urls)):
+            try:
+                return self._rest.request(
+                    method, endpoint, json_body, params, timeout=timeout
+                )
+            except RestError:
+                raise
+            except OSError as e:
+                last_exc = e
+                if len(self.api_urls) == 1:
+                    raise
+                self._rotate_replica(e)
+        assert last_exc is not None
+        raise last_exc
+
+    def _rotate_replica(self, cause: Exception) -> None:
+        """Point the transport at the next replica (all replicas are
+        stateless over one store, so any of them serves any request).
+        The in-flight proxy keeps its original URL until restart."""
+        self._url_index = (self._url_index + 1) % len(self.api_urls)
+        self.api_url = self.api_urls[self._url_index]
+        self._rest.base_url = self.api_url
+        log.warning(
+            "server connection failed (%s); rotating to replica %s",
+            cause, self.api_url,
         )
 
     # --------------------------------------------------- batched transport
@@ -1260,7 +1304,31 @@ class NodeDaemon:
             "run %s: executing %s/%s for task %s", run_id,
             task.get("image"), task.get("method"), task.get("id"),
         )
-        patch(status=TaskStatus.ACTIVE.value, started_at=time.time())
+        # activation is the dispatch serialization point: the server takes
+        # it as a compare-and-swap (PENDING -> ACTIVE, one winner). A 409
+        # here means another claimant — this daemon's own duplicate
+        # dispatch, or the same run claimed through a DIFFERENT server
+        # replica — already activated it, and executing anyway would
+        # double-run the algorithm. Unlike the terminal-state 409s that
+        # `patch` swallows mid-run, a lost activation ABORTS the run.
+        try:
+            self._report(
+                run_id, status=TaskStatus.ACTIVE.value,
+                started_at=time.time(),
+            )
+        except RuntimeError as e:
+            if "409" in str(e):
+                log.info(
+                    "run %s activation lost (already active/terminal at "
+                    "server): %s — dropping", run_id, e,
+                )
+                self.activations_lost += 1
+                return
+            raise
+        # past the CAS: this daemon is THE executor of this run. The two
+        # counters are the bench's double-dispatch ledger — across all
+        # daemons, activations_won must equal the number of runs created.
+        self.activations_won += 1
         if self.vpn.enabled:
             # register the algorithm's declared ports (module EXPOSED_PORTS;
             # reference: EXPOSE labels) as server Port entities before the

@@ -37,6 +37,7 @@ updates — the exact per-client problem PAPERS.md's CLIP paper targets.
 """
 from __future__ import annotations
 
+import json
 import math
 import threading
 import time
@@ -109,6 +110,10 @@ class RoundHistory:
 
     def __init__(self, key: Any, maxlen: int = 256):
         self.key = key
+        # set by LearningRegistry.history(): lets record() reach the
+        # registry's shared store (if one is attached) without a cycle at
+        # construction time. Standalone histories never persist.
+        self._registry: "LearningRegistry | None" = None
         self._lock = threading.Lock()
         self._records: deque[dict[str, Any]] = deque(  # guarded-by: _lock
             maxlen=max(8, maxlen)
@@ -162,12 +167,27 @@ class RoundHistory:
             for s in range(len(norms))
         ]
         gnorm = _finite(update_norm)
+        # shared-store path (N server replicas, docs/control_plane.md):
+        # the round index is allocated ATOMICALLY from the learning_round
+        # table, so round trajectories whose per-round subtasks land on
+        # different replicas still interleave into one (task, round)-keyed
+        # history instead of each replica counting 0,1,2... on its own
+        store = (
+            self._registry.store() if self._registry is not None else None
+        )
+        allocated: int | None = None
+        if store is not None and round_index is None:
+            try:
+                allocated = store.allocate(self.key)
+            except Exception:  # keep recording locally — store is additive
+                allocated = None
         with self._lock:
-            idx = (
-                int(round_index)
-                if round_index is not None
-                else self.rounds_total
-            )
+            if round_index is not None:
+                idx = int(round_index)
+            elif allocated is not None:
+                idx = allocated
+            else:
+                idx = self.rounds_total
             rec: dict[str, Any] = {
                 "round": idx,
                 "ts": float(ts) if ts is not None else time.time(),
@@ -189,6 +209,11 @@ class RoundHistory:
                 self.first_norm = gnorm
             self.peak_norm = max(self.peak_norm, gnorm)
             peak = self.peak_norm
+        if store is not None:
+            try:  # idempotent per (task, round): replays overwrite equal
+                store.save(self.key, rec)
+            except Exception:
+                pass
         self._emit(rec, peak, participating)
         return rec
 
@@ -531,12 +556,102 @@ class RoundHistory:
         return round_items, task_item
 
 
+class LearningStore:
+    """(task, round)-keyed persistence over a shared storage backend.
+
+    Backed by the ``learning_round`` table (server migration v7); ``db``
+    is duck-typed (``execute``/``query`` with a rowcount/lastrowid-bearing
+    cursor) so this module never imports sqlite3 or the server's backend
+    directly. Round allocation is one atomic INSERT..SELECT MAX+1 — two
+    replicas recording concurrently get DISTINCT round indices for the
+    same task, which is the whole point."""
+
+    def __init__(self, db: Any):
+        self.db = db
+
+    def allocate(self, key: Any) -> int:
+        """Claim the next round index for ``key`` (atomic, cross-replica)."""
+        cur = self.db.execute(
+            "INSERT INTO learning_round (task_key, round, data, ts) "
+            "SELECT ?, COALESCE(MAX(round) + 1, 0), '{}', ? "
+            "FROM learning_round WHERE task_key = ?",
+            [str(key), time.time(), str(key)],
+        )
+        row = self.db.query(
+            "SELECT round FROM learning_round WHERE rowid = ?",
+            [cur.lastrowid],
+        )
+        return int(row[0]["round"])
+
+    def save(self, key: Any, rec: dict[str, Any]) -> None:
+        self.db.execute(
+            "INSERT OR REPLACE INTO learning_round "
+            "(task_key, round, data, ts) VALUES (?, ?, ?, ?)",
+            [str(key), int(rec["round"]), json.dumps(rec),
+             rec.get("ts") or time.time()],
+        )
+
+    def load(self, key: Any) -> list[dict[str, Any]]:
+        """Every recorded round for ``key``, ordered. Unfilled allocation
+        placeholders ('{}') are skipped — an allocate whose record never
+        landed (crashed replica) leaves a gap, not a phantom round."""
+        return [
+            json.loads(r["data"])
+            for r in self.db.query(
+                "SELECT data FROM learning_round "
+                "WHERE task_key = ? AND data != '{}' ORDER BY round",
+                [str(key)],
+            )
+        ]
+
+    def task_keys(self) -> list[Any]:
+        out: list[Any] = []
+        for r in self.db.query(
+            "SELECT DISTINCT task_key FROM learning_round ORDER BY task_key"
+        ):
+            k = r["task_key"]
+            try:
+                out.append(int(k))
+            except ValueError:
+                out.append(k)
+        return out
+
+
+def history_from_rounds(key: Any, recs: list[dict[str, Any]]) -> RoundHistory:
+    """Rebuild a RoundHistory from persisted round records (no telemetry
+    or span re-emission — the recording replica already emitted them)."""
+    hist = RoundHistory(key, maxlen=max(8, len(recs)))
+    for rec in recs:
+        norms = rec.get("station_norms") or []
+        weights = rec.get("station_weights")
+        participating = [
+            weights is None or (s < len(weights) and weights[s] > 0)
+            for s in range(len(norms))
+        ]
+        gnorm = float(rec.get("update_norm") or 0.0)
+        with hist._lock:
+            hist._records.append(rec)
+            hist._feed_rounds.append(
+                hist._build_feed_item(rec, participating)
+            )
+            hist.rounds_total += 1
+            if hist.first_norm is None:
+                hist.first_norm = gnorm
+            hist.peak_norm = max(hist.peak_norm, gnorm)
+    return hist
+
+
 class LearningRegistry:
     """Keyed RoundHistory registry (process-wide singleton ``LEARNING``).
 
     Keys are task ids (ints on the server path) or caller-chosen strings
     (engine runs). Bounded FIFO: a long-lived server tracking thousands
     of tasks keeps the newest ``max_histories``.
+
+    With a shared store attached (`attach_store` — a server over a
+    ``sqlite+wal`` backend does this), every record also persists keyed
+    (task, round) and the read paths (`merged`, `summaries`) serve the
+    UNION of this process's records and every other replica's.
     """
 
     def __init__(self, max_histories: int = 512):
@@ -545,6 +660,41 @@ class LearningRegistry:
             OrderedDict()
         )  # guarded-by: _lock
         self.max_histories = max(8, max_histories)
+        self._store: LearningStore | None = None  # guarded-by: _lock
+
+    # -------------------------------------------------------- shared store
+    def attach_store(self, store: LearningStore) -> None:
+        """Route future records through a shared (task, round) store and
+        serve reads merged with it (see class docstring)."""
+        with self._lock:
+            self._store = store
+
+    def detach_store(self, store: LearningStore | None = None) -> None:
+        """Drop the store — but only if it is still OURS (identity check):
+        with two in-process replicas the second attach replaced the
+        first's store, and the first replica's close must not yank the
+        survivor's persistence out from under it."""
+        with self._lock:
+            if store is None or self._store is store:
+                self._store = None
+
+    def store(self) -> LearningStore | None:
+        with self._lock:
+            return self._store
+
+    def merged(self, key: Any) -> RoundHistory | None:
+        """The FULL history for ``key``: the shared store's view when one
+        is attached and has records (covers rounds recorded by other
+        replicas), this process's in-memory history otherwise."""
+        store = self.store()
+        if store is not None:
+            try:
+                recs = store.load(key)
+            except Exception:
+                recs = []
+            if recs:
+                return history_from_rounds(key, recs)
+        return self.get(key)
 
     def history(self, key: Any, maxlen: int = 256) -> RoundHistory:
         """Get-or-create the history for ``key``."""
@@ -554,6 +704,7 @@ class LearningRegistry:
                 hist = self._histories[key] = RoundHistory(
                     key, maxlen=maxlen
                 )
+                hist._registry = self
                 while len(self._histories) > self.max_histories:
                     self._histories.popitem(last=False)
             return hist
@@ -567,9 +718,22 @@ class LearningRegistry:
             return list(self._histories)
 
     def summaries(self) -> list[dict[str, Any]]:
+        store = self.store()
         with self._lock:
-            hists = list(self._histories.values())
-        return [h.summary() for h in hists]
+            hists = OrderedDict(self._histories)
+        if store is not None:
+            # the union of every replica's tasks, each served from the
+            # merged view — a task whose rounds landed on another replica
+            # still shows its full trajectory here
+            try:
+                store_keys = store.task_keys()
+            except Exception:
+                store_keys = []
+            for key in store_keys:
+                merged = self.merged(key)
+                if merged is not None:
+                    hists[key] = merged
+        return [h.summary() for h in hists.values()]
 
     def clear(self) -> None:
         with self._lock:

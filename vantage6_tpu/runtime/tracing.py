@@ -729,6 +729,35 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
             "n_rounds": len(learning_spans),
             "tasks": tasks,
         }
+    # per-replica call-out (docs/control_plane.md "running N replicas"):
+    # every server span carries the replica that served it, so a merged
+    # multi-replica trace file attributes request latency per replica —
+    # the load-balance / hot-replica readout for horizontal scale-out
+    replicas = None
+    by_replica: dict[str, dict[str, Any]] = {}
+    for sp in spans:
+        if sp.get("kind") != "server":
+            continue
+        rid = (sp.get("attrs") or {}).get("replica")
+        if rid is None:
+            continue
+        row = by_replica.setdefault(
+            str(rid), {"count": 0, "errors": 0, "total_ms": 0.0}
+        )
+        row["count"] += 1
+        if sp.get("status") == "error":
+            row["errors"] += 1
+        row["total_ms"] = round(
+            row["total_ms"] + sp.get("dur", 0.0) * 1e3, 3
+        )
+    if by_replica:
+        total = sum(r["count"] for r in by_replica.values())
+        for row in by_replica.values():
+            row["share_pct"] = round(100.0 * row["count"] / total, 2)
+        replicas = {
+            "n_replicas": len(by_replica),
+            "by_replica": dict(sorted(by_replica.items())),
+        }
     return {
         "n_spans": len(spans),
         "n_traces": len(traces),
@@ -738,6 +767,7 @@ def summarize(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "compression": compression,
         "device_plane": device_plane,
         "learning_plane": learning_plane,
+        "replicas": replicas,
     }
 
 

@@ -193,6 +193,25 @@ def _check_daemon_lapsed(ctx: RuleContext) -> list[dict[str, Any]]:
     return findings
 
 
+def _check_replica_lapsed(ctx: RuleContext) -> list[dict[str, Any]]:
+    findings = []
+    for rep in ctx.feed_items("replicas"):
+        if rep.get("alive"):
+            continue
+        last = rep.get("last_seen_at")
+        age = (ctx.now - float(last)) if last is not None else None
+        findings.append({
+            "message": (
+                f"server replica {rep.get('replica_id')} "
+                f"(pid {rep.get('pid')}) stopped heartbeating"
+                + (f" {age:.1f}s ago" if age is not None else "")
+                + " — crashed or partitioned from the shared store"
+            ),
+            "labels": {"replica_id": rep.get("replica_id")},
+        })
+    return findings
+
+
 def station_window_flags(
     rounds: list[dict[str, Any]],
     window: int,
@@ -596,6 +615,25 @@ def default_rules() -> list[AlertRule]:
             ),
             metrics=(),
             check=_check_daemon_lapsed,
+        ),
+        AlertRule(
+            name="replica_lapsed",
+            severity="warning",
+            summary=(
+                "A server replica sharing this store stopped heartbeating "
+                "— its process died or lost the store without a clean "
+                "shutdown. The surviving replicas keep serving; runs the "
+                "dead replica had in flight re-queue via the orphan sweep."
+            ),
+            runbook=(
+                "check /api/health `replicas` on a survivor; restart or "
+                "remove the dead replica. Attribute its in-flight work "
+                "with trace_view (spans carry replica_id). Warning, not "
+                "critical: N-1 replicas is degraded capacity, not an "
+                "outage (see docs/control_plane.md)."
+            ),
+            metrics=(),
+            check=_check_replica_lapsed,
         ),
         AlertRule(
             name="straggler_station",

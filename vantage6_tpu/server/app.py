@@ -6,6 +6,9 @@ ensure a root user, register the REST resources and the event hub, serve.
 """
 from __future__ import annotations
 
+import itertools
+import os
+import threading
 import time
 from typing import Any, Callable
 
@@ -20,6 +23,9 @@ from vantage6_tpu.server.web import App, AppServer, TestClient
 
 log = setup_logging("vantage6_tpu/server")
 
+# replica-local: disambiguates in-process replicas sharing one pid
+_REPLICA_SEQ = itertools.count(1)
+
 
 class ServerApp:
     def __init__(
@@ -29,13 +35,35 @@ class ServerApp:
         algorithm_policy: Callable[[str], bool] | None = None,
         mailer: Any = None,
         store_url: str | None = None,
+        replica_id: str | None = None,
     ):
         self.started_at = time.time()
         self.db = models.init(uri)
+        # replica identity: stamped on every request span (web.App), the
+        # heartbeat table, and /api/health — how trace_view attributes
+        # per-hop latency per replica when N of us share one store
+        self.replica_id = replica_id or os.environ.get(
+            "V6T_REPLICA_ID"
+        ) or f"srv-{os.getpid()}-{next(_REPLICA_SEQ)}"
         self.pm = PermissionManager()
         self.default_roles = self.pm.ensure_default_roles()
         self.tokens = TokenAuthority(jwt_secret)
-        self.hub = EventHub()
+        # event substrate keyed off the backend: a SHARED store (N replica
+        # processes) needs the event stream and cache-invalidation bus IN
+        # the store; a single replica keeps the in-process hub unchanged
+        if self.db.SHARED:
+            from vantage6_tpu.server.pubsub import DbPubSub, record_heartbeat
+
+            self.hub: Any = DbPubSub(self.db, replica_id=self.replica_id)
+            record_heartbeat(self.db, self.replica_id, self.started_at)
+            # cross-replica cache coherence: start draining CACHE_INVALIDATE
+            # events emitted by the peers from "now"
+            self._inval_cursor = self.hub.cursor
+        else:
+            self.hub = EventHub()
+            self._inval_cursor = 0
+        self._inval_last_drain = 0.0  # replica-local: drain rate limiter
+        self._inval_lock = threading.Lock()
         # hot-path caches (server/cache.py): token→principal resolution and
         # org→collaborations visibility. Explicitly invalidated by the
         # mutating endpoints in resources.py; short TTL as backstop.
@@ -55,8 +83,19 @@ class ServerApp:
         # through the server-side proxy at /api/store/algorithm
         self.store_url = store_url.rstrip("/") if store_url else None
         self.ws_url: str | None = None  # set by an attached WebSocketBridge
+        # replica-local: each replica serves its own websocket bridges
         self._bridges: list[Any] = []  # stopped in close()
-        self.app = App("server")
+        self.app = App("server", replica_id=self.replica_id)
+        # learning plane over the shared store: round records key on
+        # (task, round) in the learning_round table, so a trajectory whose
+        # per-round subtasks were served by different replicas still reads
+        # back as ONE history from /api/rounds (runtime/learning.py)
+        self._learning_store: Any = None
+        if self.db.SHARED:
+            from vantage6_tpu.runtime.learning import LEARNING, LearningStore
+
+            self._learning_store = LearningStore(self.db)
+            LEARNING.attach_store(self._learning_store)
         # unified telemetry (common.telemetry): this server's hot-state
         # gauges — event hub fill/eviction, cache hit rates — join the
         # process-wide wire/REST/executor/tracing series behind
@@ -83,12 +122,50 @@ class ServerApp:
 
         register_ui(self)
 
+    def drain_invalidations(self) -> None:
+        """Apply CACHE_INVALIDATE events other replicas committed to the
+        shared stream (resources.py emits them next to its local
+        invalidate calls). Called from the auth hot path, rate-limited to
+        one stream read per ~25 ms — the cross-replica staleness bound;
+        the caches' own TTL stays the backstop. No-op on an in-process
+        hub: there a local invalidate already covered the only replica."""
+        if not getattr(self.hub, "SHARED", False):
+            return
+        now = time.monotonic()
+        with self._inval_lock:
+            if now - self._inval_last_drain < 0.025:
+                return
+            self._inval_last_drain = now
+            cursor = self._inval_cursor
+        from vantage6_tpu.server.events import CACHE_INVALIDATE, REPLICA_ROOM
+
+        try:
+            events = self.hub.fetch(since=cursor, rooms=[REPLICA_ROOM])
+            new_cursor = self.hub.cursor
+        except Exception:  # backend busy — next request retries
+            return
+        for ev in events:
+            if ev.name != CACHE_INVALIDATE:
+                continue
+            entity = (ev.data or {}).get("entity")
+            pid = (ev.data or {}).get("id")
+            if entity in ("user", "node") and pid is not None:
+                self.auth_cache.invalidate_principal(entity, pid)
+            elif entity in ("role", "rule"):
+                self.auth_cache.invalidate_all()
+            elif entity == "collaboration":
+                self.vis_cache.invalidate_all()
+        with self._inval_lock:
+            self._inval_cursor = max(self._inval_cursor, new_cursor)
+
     def _watchdog_feed(self) -> dict[str, Any]:
         """The server's run/node state for the watchdog rules: every
         ACTIVE run (with the task's traceparent so a stuck_run alert lands
         on the round's own trace) and every online node's ping freshness.
         Runs on the watchdog thread — db.py keeps one sqlite connection
-        per thread for exactly this access pattern."""
+        per thread for exactly this access pattern. On a SHARED backend
+        the periodic tick doubles as this replica's heartbeat, and the
+        peers' heartbeat rows feed the `replica_lapsed` rule."""
         if models.Model.db is None:  # closed mid-evaluation
             return {}
         runs = []
@@ -116,7 +193,18 @@ class ServerApp:
             }
             for n in models.Node.list(status="online")
         ]
-        return {"runs": runs, "nodes": nodes}
+        feed: dict[str, Any] = {"runs": runs, "nodes": nodes}
+        if self.db.SHARED:
+            from vantage6_tpu.server import pubsub
+
+            try:
+                pubsub.record_heartbeat(
+                    self.db, self.replica_id, self.started_at
+                )
+                feed["replicas"] = pubsub.list_replicas(self.db)
+            except Exception:  # heartbeat must never break the rule feeds
+                pass
+        return feed
 
     def _hub_check(self) -> tuple[bool, str]:
         try:
@@ -179,8 +267,22 @@ class ServerApp:
             pass
         WATCHDOG.stop()
         REGISTRY.unregister_collector("server", self._telemetry_collector)
-        self.db.close()
-        models.Model.db = None
+        if self._learning_store is not None:
+            from vantage6_tpu.runtime.learning import LEARNING
+
+            LEARNING.detach_store(self._learning_store)
+        if self.db.SHARED:
+            from vantage6_tpu.server import pubsub
+
+            try:  # clean departure: don't linger as "lapsed" in peers' health
+                pubsub.drop_heartbeat(self.db, self.replica_id)
+            except Exception:  # pragma: no cover - teardown must not fail
+                pass
+        if hasattr(self.hub, "close"):
+            self.hub.close()
+        # refcounted: with in-process replicas over one SHARED store, only
+        # the last close actually unbinds/closes the database (models.release)
+        models.release(self.db)
 
     # ----------------------------------------------------------------- seed
     def ensure_root(

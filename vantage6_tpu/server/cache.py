@@ -17,9 +17,14 @@ invalidate hooks) plus a short TTL as belt-and-braces:
   org belongs to (the check `resources.py` used to re-query per run/row).
   Invalidation: global on any collaboration-membership mutation.
 
-Both caches are process-local, exactly matching the single-process server's
-consistency domain: every mutation that must invalidate goes through this
-same process's REST handlers.
+Both caches are process-local. On a single-replica server that matches the
+consistency domain exactly: every mutation that must invalidate goes
+through this same process's REST handlers. With N replicas over a shared
+store (docs/control_plane.md), a mutation can land on a DIFFERENT replica —
+there `resources._invalidate` also publishes a `CACHE_INVALIDATE` event on
+the shared stream and every replica's auth hot path drains it
+(`ServerApp.drain_invalidations`, rate-limited to ~25 ms), so cross-replica
+staleness is bounded by the drain interval with the TTL as the backstop.
 """
 from __future__ import annotations
 
@@ -35,6 +40,7 @@ class AuthCache:
         self.ttl = ttl
         self.maxsize = maxsize
         self._lock = threading.Lock()
+        # replica-local: coherent via the CACHE_INVALIDATE bus + TTL
         self._entries: dict[str, tuple[float, str, Any]] = {}  # guarded-by: _lock
         self.hits = 0  # guarded-by: _lock
         self.misses = 0  # guarded-by: _lock
@@ -94,6 +100,7 @@ class VisibilityCache:
     def __init__(self, ttl: float = 30.0):
         self.ttl = ttl
         self._lock = threading.Lock()
+        # replica-local: coherent via the CACHE_INVALIDATE bus + TTL
         self._entries: dict[int, tuple[float, frozenset[int]]] = {}  # guarded-by: _lock
         # hit/miss accounting for the unified telemetry registry — the
         # same observability the AuthCache already had

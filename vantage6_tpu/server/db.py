@@ -10,6 +10,20 @@ single-writer control plane).
 
 Thread safety: one connection per thread (the WSGI server is threaded);
 sqlite handles cross-process locking.
+
+Storage backends: the server binds its models through `open_backend(uri)`,
+which dispatches on the URI scheme via the `BACKENDS` registry:
+
+- ``sqlite`` — the `Database` below: single replica, dev/test default
+  (`:memory:` supported through one shared connection).
+- ``sqlite+wal`` — `WalDatabase`: one WAL file SHARED by N server replica
+  processes; every statement retries on SQLITE_BUSY with backoff, and the
+  backend advertises ``SHARED = True`` so the app layer switches the event
+  hub, cache invalidation and learning plane onto shared-store substrates.
+
+A Postgres driver drops in by registering another class with the same
+execute/query/close surface (rowcount-bearing cursors are the only
+contract `Model.compare_and_swap` needs).
 """
 from __future__ import annotations
 
@@ -35,8 +49,15 @@ _TYPES = {
 class Database:
     """One sqlite database; thread-local connections."""
 
+    # backend identity: the scheme this class serves in `BACKENDS`, and
+    # whether N server processes may share one store (drives the app
+    # layer's hub/cache/learning substrate selection)
+    KIND: ClassVar[str] = "sqlite"
+    SHARED: ClassVar[bool] = False
+
     def __init__(self, uri: str = "sqlite:///:memory:"):
-        self.path = uri.removeprefix("sqlite:///") if uri.startswith("sqlite") else uri
+        self.uri = uri
+        self.path = uri.split(":///", 1)[1] if ":///" in uri else uri
         self._local = threading.local()
         self._memory_conn: sqlite3.Connection | None = None
         if self.path != ":memory:":
@@ -105,6 +126,79 @@ class Database:
         if c is not None:
             c.close()
             self._local.conn = None
+
+
+class WalDatabase(Database):
+    """Shared-file WAL backend: N server replica PROCESSES over one store.
+
+    The base class already opens every connection in WAL mode with a 5 s
+    busy handler; what changes here is the failure contract. A single
+    replica can treat SQLITE_BUSY as a bug (nothing else holds the file);
+    with N replicas it is a normal collision on the single WAL writer
+    slot, so every statement retries with exponential backoff before
+    giving up. Statements that pass through here are safe to re-issue:
+    the model layer's guarded updates (`Model.compare_and_swap`) carry
+    their own `WHERE` state guards, and a retried INSERT only runs again
+    when the first attempt's transaction rolled back.
+    """
+
+    KIND = "sqlite+wal"
+    SHARED = True
+    BUSY_RETRIES = 6
+
+    def __init__(self, uri: str):
+        super().__init__(uri)
+        if self.path == ":memory:":
+            raise ValueError(
+                "sqlite+wal needs a file path shared between replicas; "
+                ":memory: is per-process by construction"
+            )
+
+    def _retry(self, fn):
+        delay = 0.005
+        for attempt in range(self.BUSY_RETRIES):
+            try:
+                return fn()
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if "locked" not in msg and "busy" not in msg:
+                    raise
+                try:  # drop any half-open transaction before re-issuing
+                    self.conn.rollback()
+                except sqlite3.Error:  # pragma: no cover - teardown race
+                    pass
+                if attempt == self.BUSY_RETRIES - 1:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 0.25)
+
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
+        params = tuple(params)  # a generator must survive the re-issue
+        return self._retry(lambda: Database.execute(self, sql, params))
+
+    def query(self, sql: str, params: Iterable[Any] = ()) -> list[sqlite3.Row]:
+        params = tuple(params)
+        return self._retry(lambda: Database.query(self, sql, params))
+
+
+# scheme -> backend class; `open_backend` dispatches on the URI scheme so a
+# Postgres driver later is one registry entry, not an app-layer rewrite
+BACKENDS: dict[str, type[Database]] = {
+    Database.KIND: Database,
+    WalDatabase.KIND: WalDatabase,
+}
+
+
+def open_backend(uri: str) -> Database:
+    """Open the storage backend the URI scheme names (default: sqlite)."""
+    scheme = uri.split(":///", 1)[0] if ":///" in uri else "sqlite"
+    cls = BACKENDS.get(scheme)
+    if cls is None:
+        raise ValueError(
+            f"unknown storage backend {scheme!r} "
+            f"(registered: {sorted(BACKENDS)})"
+        )
+    return cls(uri)
 
 
 class Model:
@@ -198,16 +292,19 @@ class Model:
                 )
 
     # ------------------------------------------------------------- marshal
-    def _encode(self, col: str) -> Any:
-        v = getattr(self, col)
-        t = self.COLUMNS[col]
+    @classmethod
+    def _encode_value(cls, col: str, v: Any) -> Any:
+        t = cls.COLUMNS.get(col)
         if v is None:
             return None
         if t == "json":
             return json.dumps(v)
-        if t == "bool":
+        if t == "bool" or isinstance(v, bool):
             return int(v)
         return v
+
+    def _encode(self, col: str) -> Any:
+        return self._encode_value(col, getattr(self, col))
 
     @classmethod
     def _from_row(cls: type[T], row: sqlite3.Row) -> T:
@@ -289,6 +386,37 @@ class Model:
     def first(cls: type[T], **where: Any) -> T | None:
         rows = cls.list(limit=1, **where)
         return rows[0] if rows else None
+
+    @classmethod
+    def compare_and_swap(
+        cls, id_: int, sets: dict[str, Any], expect: dict[str, Any]
+    ) -> bool:
+        """Atomic guarded update — the ONE primitive every cross-replica
+        read-modify-write (run claim/activation, status transition, orphan
+        reset) is built on: ``UPDATE ... SET <sets> WHERE id = ? AND
+        <expect>`` in a single statement, so the state check and the write
+        cannot interleave with another replica's. Returns True iff the row
+        was in exactly the expected state and is now updated; False means
+        the caller lost the race and must re-read before deciding."""
+        if not sets:
+            raise TypeError(f"{cls.__name__}.compare_and_swap: empty sets")
+        cls._check_columns(sets, "set")
+        cls._check_columns(expect, "where")
+        set_sql = ", ".join(f'"{c}" = ?' for c in sets)
+        params: list[Any] = [cls._encode_value(c, v) for c, v in sets.items()]
+        conds = ["id = ?"]
+        params.append(id_)
+        for k, v in expect.items():
+            if v is None:
+                conds.append(f'"{k}" IS NULL')
+            else:
+                conds.append(f'"{k}" = ?')
+                params.append(cls._encode_value(k, v))
+        cur = cls._db().execute(
+            f"UPDATE {cls.TABLE} SET {set_sql} WHERE " + " AND ".join(conds),
+            params,
+        )
+        return cur.rowcount == 1
 
     @classmethod
     def count(cls, **where: Any) -> int:

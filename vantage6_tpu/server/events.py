@@ -26,6 +26,14 @@ STATUS_UPDATE = "status-update"
 KILL_TASK = "kill-task"
 PING = "ping"
 SESSION_DELETED = "session-deleted"  # nodes drop their local session store
+# server-internal: one replica's cache invalidation, applied by the others
+# (data: {"entity": user|node|role|rule|collaboration, "id": int|None});
+# rides the shared event stream in REPLICA_ROOM, which no client's room
+# set ever includes, so daemons/UIs never see it
+CACHE_INVALIDATE = "cache-invalidate"
+
+# server-to-server room for CACHE_INVALIDATE (never granted to clients)
+REPLICA_ROOM = "replicas"
 
 
 def collaboration_room(collaboration_id: int) -> str:
@@ -62,15 +70,18 @@ class EventHub:
 
     def __init__(self, buffer_size: int = 4096):
         self.buffer_size = buffer_size
+        # EventHub is the SINGLE-replica hub; shared-store deployments
+        # replica-local: swap in DbPubSub (app.py selects on db.SHARED)
         self._buffer: deque[Event] = deque(maxlen=buffer_size)  # guarded-by: _lock
-        self._seq = itertools.count(1)
+        self._seq = itertools.count(1)  # replica-local: see _buffer
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         # seq of the newest event the bounded buffer has DROPPED (0: none)
         self._evicted_through = 0  # guarded-by: _lock
         # subscriber id -> (rooms | None for all, callback)
+        # replica-local: push subscribers live in THIS process
         self._subs: dict[int, tuple[set[str] | None, Callable[[Event], None]]] = {}  # guarded-by: _lock
-        self._sub_ids = itertools.count(1)
+        self._sub_ids = itertools.count(1)  # replica-local: see _subs
 
     # ------------------------------------------------------------------ emit
     def emit(self, name: str, data: dict[str, Any], room: str = "all") -> Event:

@@ -32,6 +32,7 @@ class LogMailer:
     """Default: log + retain messages in memory (dev/test deployments)."""
 
     def __init__(self) -> None:
+        # replica-local: dev/test capture buffer, never authoritative
         self.sent: list[Message] = []
 
     def send(self, to: str, subject: str, body: str) -> None:

@@ -120,6 +120,39 @@ def _m6_trace_metadata(db: Database) -> None:
     )
 
 
+def _m7_replica_tables(db: Database) -> None:
+    """v7: shared-store substrate for N server replicas. Four tables:
+    the cross-replica event stream (`pubsub_event` — the DbPubSub ring,
+    append + bounded prune), its watermark (`pubsub_meta` — eviction
+    floor so truncation survives the pruner), replica liveness
+    (`replica_heartbeat` — /api/health and the watchdog's replica view),
+    and the learning plane keyed by (task, round) (`learning_round` — a
+    round trajectory whose per-round subtasks land on different replicas
+    still reads back as ONE history)."""
+    db.execute(
+        "CREATE TABLE IF NOT EXISTS pubsub_event ("
+        "seq INTEGER PRIMARY KEY AUTOINCREMENT, "
+        "name TEXT NOT NULL, room TEXT NOT NULL, "
+        "data TEXT, ts REAL NOT NULL)"
+    )
+    db.execute(
+        "CREATE TABLE IF NOT EXISTS pubsub_meta ("
+        "key TEXT PRIMARY KEY, value INTEGER NOT NULL)"
+    )
+    db.execute(
+        "CREATE TABLE IF NOT EXISTS replica_heartbeat ("
+        "replica_id TEXT PRIMARY KEY, pid INTEGER, "
+        "started_at REAL NOT NULL, last_seen_at REAL NOT NULL)"
+    )
+    db.execute(
+        "CREATE TABLE IF NOT EXISTS learning_round ("
+        "task_key TEXT NOT NULL, round INTEGER NOT NULL, "
+        "data TEXT NOT NULL, ts REAL NOT NULL, "
+        "PRIMARY KEY (task_key, round))"
+    )
+
+
+# replica-local: code-derived constant, identical on every replica
 MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
     (1, "baseline schema", _m1_baseline),
     (2, "unique index on user.username (+dedupe)", _m2_unique_username),
@@ -129,6 +162,8 @@ MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
     (5, "dispatch-path indexes: run(org,status), run(node,status)",
      _m5_dispatch_indexes),
     (6, "tracing metadata index: task(trace_id)", _m6_trace_metadata),
+    (7, "replica tables: pubsub event stream, heartbeats, learning rounds",
+     _m7_replica_tables),
 ]
 
 SCHEMA_VERSION = MIGRATIONS[-1][0]

@@ -15,7 +15,7 @@ import time
 from typing import Any
 
 from vantage6_tpu.common.enums import TaskStatus
-from vantage6_tpu.server.db import Database, LinkTable, Model
+from vantage6_tpu.server.db import Database, LinkTable, Model, open_backend
 
 # ------------------------------------------------------------------ entities
 
@@ -487,6 +487,7 @@ user_role = LinkTable("user_role", "user_id", "role_id")
 role_rule = LinkTable("role_rule", "role_id", "rule_id")
 user_rule = LinkTable("user_rule", "user_id", "rule_id")
 
+# replica-local: code-derived constant, identical on every replica
 ALL_MODELS: list[type[Model]] = [
     Organization,
     Collaboration,
@@ -501,7 +502,13 @@ ALL_MODELS: list[type[Model]] = [
     Session,
     SessionDataframe,
 ]
+# replica-local: code-derived constant, identical on every replica
 ALL_LINKS = [collaboration_member, study_member, user_role, role_rule, user_rule]
+
+
+# how many ServerApps share the current Model.db binding (SHARED backends
+# allow in-process replicas over one store; see init/release)
+_BINDING_REFS = 0  # replica-local: refcount of THIS process's db binding
 
 
 def init(uri: str = "sqlite:///:memory:", replace: bool = False) -> Database:
@@ -512,14 +519,25 @@ def init(uri: str = "sqlite:///:memory:", replace: bool = False) -> Database:
     first would silently redirect live handlers, so it raises instead.
     Services needing their own DB in-process (the algorithm store) use their
     own `Model` subclass hierarchy with its own `db` binding.
+
+    Exception: a SHARED backend (``sqlite+wal``) re-initialised with the
+    SAME uri returns the existing binding refcounted — two in-process
+    server replicas over one store are exactly the multi-replica topology
+    the backend exists for. `release()` unbinds only when the last
+    holder lets go.
     """
+    global _BINDING_REFS
     if Model.db is not None and not replace:
+        if Model.db.SHARED and getattr(Model.db, "uri", None) == uri:
+            _BINDING_REFS += 1
+            return Model.db
         raise RuntimeError(
             "server models already bound to a database; close it and set "
             "Model.db = None (or pass replace=True) before rebinding"
         )
-    db = Database(uri)
+    db = open_backend(uri)
     Model.db = db
+    _BINDING_REFS = 1
     for m in ALL_MODELS:
         m.ensure_schema()
     for link in ALL_LINKS:
@@ -530,3 +548,20 @@ def init(uri: str = "sqlite:///:memory:", replace: bool = False) -> Database:
 
     migrations.migrate(db)
     return db
+
+
+def release(db: Database) -> None:
+    """Drop one holder's claim on the binding `init` returned. The binding
+    (and connection) is closed only when the LAST in-process holder
+    releases — a shared-backend replica closing must not unbind the other
+    replica's live handlers mid-request."""
+    global _BINDING_REFS
+    if Model.db is not db:
+        # already rebound (tests replace=True) — close the orphan quietly
+        db.close()
+        return
+    _BINDING_REFS -= 1
+    if _BINDING_REFS <= 0:
+        _BINDING_REFS = 0
+        db.close()
+        Model.db = None

@@ -24,6 +24,7 @@ class Scope(str, enum.Enum):
         return _SCOPE_ORDER.index(self)
 
 
+# replica-local: code-derived constant, identical on every replica
 _SCOPE_ORDER = [Scope.OWN, Scope.ORGANIZATION, Scope.COLLABORATION, Scope.GLOBAL]
 
 
@@ -38,6 +39,7 @@ class Operation(str, enum.Enum):
 
 # resource -> operations that exist for it (the rule matrix the reference
 # seeds at server start)
+# replica-local: code-derived constant, identical on every replica
 RESOURCE_OPERATIONS: dict[str, list[Operation]] = {
     "user": [Operation.VIEW, Operation.CREATE, Operation.EDIT, Operation.DELETE],
     "organization": [Operation.VIEW, Operation.CREATE, Operation.EDIT, Operation.DELETE],
@@ -56,6 +58,7 @@ RESOURCE_OPERATIONS: dict[str, list[Operation]] = {
 }
 
 # scopes that make sense per resource: OWN only where a row has an owner
+# replica-local: code-derived constant, identical on every replica
 _OWNED = {"user", "task", "run", "session"}
 
 
@@ -70,6 +73,8 @@ class PermissionManager:
     """Seeds the rule matrix and answers 'may user U do O on R at scope S?'"""
 
     def __init__(self) -> None:
+        # cache of store-seeded rule ids — every replica derives the
+        # replica-local: identical mapping from the shared store
         self._rule_ids: dict[tuple[str, str, str], int] = {}
         self.seed_rules()
 

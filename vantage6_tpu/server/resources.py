@@ -38,6 +38,10 @@ def identity_from_token(srv: "ServerApp", token: str | None) -> tuple[str, Any]:
     """
     if not token:
         raise HTTPError(401, "missing bearer token")
+    # cross-replica coherence: apply peers' pending cache invalidations
+    # BEFORE consulting this replica's caches (rate-limited; no-op on an
+    # in-process hub — see ServerApp.drain_invalidations)
+    srv.drain_invalidations()
     cached = srv.auth_cache.get(token)
     if cached is not None:
         return cached
@@ -90,6 +94,32 @@ def _visible_collab_ids(srv: "ServerApp", org_id: int) -> frozenset[int]:
 
 def _identity(srv: "ServerApp", req: Request) -> tuple[str, Any]:
     return identity_from_token(srv, req.bearer_token)
+
+
+def _invalidate(srv: "ServerApp", entity: str, id_: int | None = None) -> None:
+    """ONE invalidation call per mutation site: applies to this replica's
+    caches immediately and — when the event hub is the shared-store bus —
+    publishes a CACHE_INVALIDATE event so every OTHER replica applies it
+    too (ServerApp.drain_invalidations). Entities: user/node evict the
+    principal's tokens; role/rule evict the whole auth cache (a role's
+    rule set reaches arbitrarily many users); collaboration evicts the
+    visibility cache."""
+    if entity in ("user", "node") and id_ is not None:
+        srv.auth_cache.invalidate_principal(entity, id_)
+    elif entity in ("role", "rule"):
+        srv.auth_cache.invalidate_all()
+    elif entity == "collaboration":
+        srv.vis_cache.invalidate_all()
+    if getattr(srv.hub, "SHARED", False):
+        from vantage6_tpu.server.events import CACHE_INVALIDATE, REPLICA_ROOM
+
+        try:
+            srv.hub.emit(
+                CACHE_INVALIDATE, {"entity": entity, "id": id_},
+                room=REPLICA_ROOM,
+            )
+        except Exception:  # the local invalidation already happened;
+            pass  # peers' TTL is the backstop if the emit is lost
 
 
 def _require_user(srv: "ServerApp", req: Request) -> m.User:
@@ -213,11 +243,12 @@ def register_resources(srv: "ServerApp") -> None:
         from vantage6_tpu.runtime.tracing import TRACER
 
         verdict = srv.watchdog.health()
-        return {
+        out = {
             "status": verdict["status"],
             "components": verdict["components"],
             "alerts": {**verdict["alerts"], "url": "/api/alerts"},
             "uptime": time.time() - srv.started_at,
+            "replica_id": srv.replica_id,
             "version": __version__,
             # advertised so nodes/UIs can upgrade from polling to push
             "websocket_url": srv.ws_url,
@@ -226,6 +257,13 @@ def register_resources(srv: "ServerApp") -> None:
             "metrics": "/api/metrics",
             "tracing": TRACER.enabled,
         }
+        if srv.db.SHARED:
+            # shared-store deployments: the fleet view, read from DB truth
+            # (replica_heartbeat) — "did a replica die" is answered here
+            from vantage6_tpu.server import pubsub
+
+            out["replicas"] = pubsub.list_replicas(srv.db)
+        return out
 
     @app.route("/api/alerts")
     def alerts(req: Request):
@@ -264,10 +302,13 @@ def register_resources(srv: "ServerApp") -> None:
         `non_convergence` / `model_divergence` watchdog rules read, served
         raw so an operator (or the doctor) can see WHY an alert fired.
         404 for tasks the learning registry never tracked (host-mode
-        tasks without an engine/aggregation recording)."""
+        tasks without an engine/aggregation recording). Served from the
+        MERGED view: on a shared backend, rounds recorded via other
+        replicas (per-round subtasks land wherever the daemon's poll
+        lands) are part of this task's one trajectory."""
         from vantage6_tpu.runtime.learning import LEARNING
 
-        hist = LEARNING.get(id)
+        hist = LEARNING.merged(id)
         if hist is None:
             raise HTTPError(
                 404,
@@ -470,7 +511,7 @@ def register_resources(srv: "ServerApp") -> None:
         user.failed_login_attempts = 0
         user.save()
         # the fingerprint rotation must bite NOW, not at cache TTL
-        srv.auth_cache.invalidate_principal("user", user.id)
+        _invalidate(srv, "user", user.id)
         return {"msg": "password updated"}
 
     @app.route("/api/password/change", methods=("POST",))
@@ -493,7 +534,7 @@ def register_resources(srv: "ServerApp") -> None:
         user.failed_login_attempts = 0
         user.save()
         # every outstanding token (incl. a cached attacker session) dies now
-        srv.auth_cache.invalidate_principal("user", user.id)
+        _invalidate(srv, "user", user.id)
         return {"msg": "password updated — all sessions are now invalid; "
                        "log in again"}
 
@@ -531,7 +572,7 @@ def register_resources(srv: "ServerApp") -> None:
         user = _user_for_reset_token(srv, body["reset_token"])
         user.totp_secret = generate_totp_secret()
         user.save()
-        srv.auth_cache.invalidate_principal("user", user.id)
+        _invalidate(srv, "user", user.id)
         # the new secret is returned ONCE for authenticator re-enrollment
         return {"totp_secret": user.totp_secret}
 
@@ -591,7 +632,7 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             )
             target.delete()
-            srv.auth_cache.invalidate_principal("user", target.id)
+            _invalidate(srv, "user", target.id)
             return {}, 204
         _check(
             pm.allowed(
@@ -621,7 +662,7 @@ def register_resources(srv: "ServerApp") -> None:
                 m.user_role.add(target.id, role.id)
         target.save()
         # fields/credentials/roles may all have changed: drop cached tokens
-        srv.auth_cache.invalidate_principal("user", target.id)
+        _invalidate(srv, "user", target.id)
         return target.to_dict()
 
     # ------------------------------------------------------- organizations
@@ -750,7 +791,7 @@ def register_resources(srv: "ServerApp") -> None:
         ).save()
         for oid in body["organization_ids"]:
             collab.add_organization(_get_or_404(m.Organization, oid))
-        srv.vis_cache.invalidate_all()
+        _invalidate(srv, "collaboration")
         return collab.to_dict(), 201
 
     @app.route("/api/collaboration/<int:id>", methods=("GET", "PATCH", "DELETE"))
@@ -782,7 +823,7 @@ def register_resources(srv: "ServerApp") -> None:
                 == Scope.GLOBAL
             )
             collab.delete()
-            srv.vis_cache.invalidate_all()
+            _invalidate(srv, "collaboration")
             return {}, 204
         _check(
             pm.allowed(
@@ -798,7 +839,7 @@ def register_resources(srv: "ServerApp") -> None:
         if body.get("organization_ids"):
             for oid in body["organization_ids"]:
                 collab.add_organization(_get_or_404(m.Organization, oid))
-            srv.vis_cache.invalidate_all()
+            _invalidate(srv, "collaboration")
         return collab.to_dict()
 
     # -------------------------------------------------------------- studies
@@ -1054,7 +1095,7 @@ def register_resources(srv: "ServerApp") -> None:
                 )
             )
             node.delete()
-            srv.auth_cache.invalidate_principal("node", node.id)
+            _invalidate(srv, "node", node.id)
             return {}, 204
         _check(
             pm.allowed(
@@ -1354,26 +1395,25 @@ def register_resources(srv: "ServerApp") -> None:
                     ):
                         if run.id in exclude or not _in_scope(run):
                             continue
-                        # conditional UPDATE, not save(): between the
+                        # compare-and-swap, not save(): between the
                         # listing and this write the run may have been
-                        # COMPLETED by a concurrent report — a stale
-                        # full-row save would clobber the result and
-                        # re-queue finished work. The status guard makes
-                        # the reset atomic; rowcount 0 = someone else
-                        # moved the run on, leave it alone.
-                        cur = m.TaskRun._db().execute(
-                            f"UPDATE {m.TaskRun.TABLE} "
-                            "SET status = ?, log = ? "
-                            "WHERE id = ? AND status = ?",
-                            [
-                                TaskStatus.PENDING.value,
-                                "orphaned mid-run (daemon restart or "
-                                "lost report); re-queued by claim-batch",
-                                run.id,
-                                status.value,
-                            ],
-                        )
-                        if cur.rowcount == 0:
+                        # COMPLETED by a concurrent report — or ACTIVATED
+                        # by the daemon through ANOTHER replica. A stale
+                        # full-row save would clobber the result or
+                        # re-queue live work; the status guard makes the
+                        # reset atomic, and a False return means someone
+                        # else moved the run on — leave it alone.
+                        if not m.TaskRun.compare_and_swap(
+                            run.id,
+                            sets={
+                                "status": TaskStatus.PENDING.value,
+                                "log": (
+                                    "orphaned mid-run (daemon restart or "
+                                    "lost report); re-queued by claim-batch"
+                                ),
+                            },
+                            expect={"status": status.value},
+                        ):
                             continue
                         n_reset += 1
                         task = _task_of(run)
@@ -1481,7 +1521,7 @@ def register_resources(srv: "ServerApp") -> None:
         if req.method == "DELETE":
             role.delete()
             # the role's rules reached arbitrarily many users: global evict
-            srv.auth_cache.invalidate_all()
+            _invalidate(srv, "role")
             return {}, 204
         body = sch.load(sch.RolePatch(), req.json)
         for field in ("name", "description"):
@@ -1489,7 +1529,7 @@ def register_resources(srv: "ServerApp") -> None:
                 setattr(role, field, body[field])
         if body["rules"] is not None:
             _grant_role_rules(user, role, body["rules"], replace=True)
-            srv.auth_cache.invalidate_all()
+            _invalidate(srv, "role")
         role.save()
         return role.to_dict()
 
@@ -1913,22 +1953,59 @@ def _apply_run_patch(
         run.organization_id == node.organization_id
         and task.collaboration_id == node.collaboration_id
     )
-    if (
-        body["status"]
-        and run.status
-        and TaskStatus(run.status).is_finished
-    ):
-        # terminal states are immutable: a node finishing late must not
-        # overwrite KILLED (or re-open a completed run)
-        raise HTTPError(
-            409, f"run {run.id} already {run.status}; cannot change"
-        )
-    for field in ("status", "result", "log", "started_at", "finished_at"):
-        if body[field] is not None:
-            setattr(run, field, body[field])
-    if body["status"] and run.node_id is None:
-        run.node_id = node.id
-    run.save()
+    new_status = body["status"]
+    if new_status:
+        # status transitions are compare-and-swap on the status we READ:
+        # with N replicas over one store, the check and the write must be
+        # one atomic statement or two replicas interleave (the
+        # double-dispatch hole). One winner; losers get 409. The guards:
+        # terminal states are immutable (a node finishing late must not
+        # overwrite KILLED or re-open a completed run), and activating an
+        # already-ACTIVE run is a lost activation race — the 409 is what
+        # makes the daemon drop the run instead of executing it twice.
+        # (The container token minted at claim time is a stateless JWT;
+        # THIS activation CAS is the dispatch serialization point.)
+        for _attempt in range(2):
+            cur_status = run.status
+            if cur_status and TaskStatus(cur_status).is_finished:
+                raise HTTPError(
+                    409, f"run {run.id} already {cur_status}; cannot change"
+                )
+            if (
+                new_status == TaskStatus.ACTIVE.value
+                and cur_status == TaskStatus.ACTIVE.value
+            ):
+                raise HTTPError(
+                    409,
+                    f"run {run.id} already active "
+                    "(activation race lost to another claimant)",
+                )
+            sets: dict[str, Any] = {"status": new_status}
+            for field in ("result", "log", "started_at", "finished_at"):
+                if body[field] is not None:
+                    sets[field] = body[field]
+            if run.node_id is None:
+                sets["node_id"] = node.id
+            if m.TaskRun.compare_and_swap(
+                run.id, sets, expect={"status": cur_status}
+            ):
+                for k, v in sets.items():
+                    setattr(run, k, v)
+                break
+            # lost the race: re-read and re-decide against the NEW state
+            reread = m.TaskRun.get(run.id)
+            if reread is None:
+                raise HTTPError(404, "run deleted mid-update")
+            run = reread
+        else:  # two lost races in a row: punt to the caller
+            raise HTTPError(
+                409, f"run {run.id} status contended; re-fetch and retry"
+            )
+    else:
+        for field in ("result", "log", "started_at", "finished_at"):
+            if body[field] is not None:
+                setattr(run, field, body[field])
+        run.save()
     if body["status"]:
         srv.hub.emit(
             ev.STATUS_UPDATE,

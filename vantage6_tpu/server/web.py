@@ -122,6 +122,7 @@ class Response:
 
 
 _PARAM_RE = re.compile(r"<(?:(int|str):)?(\w+)>")
+# replica-local: code-derived constant, identical on every replica
 _STATUS_TEXT = {
     200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
     401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
@@ -134,10 +135,15 @@ Handler = Callable[..., Any]
 class App:
     """Route registry + WSGI callable."""
 
-    def __init__(self, name: str = "app"):
+    def __init__(self, name: str = "app", replica_id: str | None = None):
         self.name = name
+        # stamped on every request span: with N replicas over one store,
+        # trace_view attributes per-hop latency to the replica that served
+        # it (empty for single-purpose apps like the store service)
+        self.replica_id = replica_id
         # (regex, {method: handler}, original pattern — the low-cardinality
         # span/metric label: "/api/run/<int:id>" instead of "/api/run/17")
+        # replica-local: route table built from code at startup
         self._routes: list[
             tuple[re.Pattern[str], dict[str, Handler], str]
         ] = []
@@ -146,6 +152,7 @@ class App:
         # dominate the p95 the metric exists to report. Declared at
         # registration (`untimed=True`) — route semantics belong to the
         # route, not to query-param sniffing in the shared request path.
+        # replica-local: declared from code at route registration
         self._untimed: set[str] = set()
         self._auth_hook: Callable[[Request], None] | None = None
 
@@ -215,6 +222,8 @@ class App:
                 f"http {request.method} {pattern}", kind="server",
                 parent=parent, service=self.name, require_parent=True,
             ) as span:
+                if self.replica_id:
+                    span.set_attr(replica=self.replica_id)
                 try:
                     if self._auth_hook is not None:
                         self._auth_hook(request)
