@@ -175,6 +175,17 @@ WIRE_BROADCAST_N = 8          # acceptance: broadcast-to-8 within 2x single
 # cost (device.compress spans) under 10% of round time. Sized like the
 # agg_modes leg: small local compute, the DELTA EXCHANGE is the subject.
 COMPRESS_TIMEOUT_S = 600
+# autopilot leg (robustness PR): buffered-async straggler resilience —
+# one V6T_FAULTS-delayed station of AP_STATIONS, sync rounds crater to
+# ~1/delay while run_buffered (quorum S-1, over-select 1) must hold >=
+# AP_RESILIENCE_PCT of the clean sync rounds/sec at aggregate parity —
+# plus the closed-loop smoke: a label-flip-poisoned station is
+# auto-masked by the autopilot (anomalous_station -> mask_station),
+# accuracy recovers hands-off, and the mask reverts on alert clear.
+AP_TIMEOUT_S = 420
+AP_STATIONS = 8
+AP_ROUNDS = 6
+AP_RESILIENCE_PCT = 80.0
 COMPRESS_STATIONS = 8
 COMPRESS_ROUNDS = 3
 COMPRESS_TOPK = 0.1           # keep 10% of coordinates
@@ -2350,6 +2361,259 @@ def worker_compression() -> None:
     }))
 
 
+def worker_autopilot() -> None:
+    """autopilot leg: robustness PR acceptance, two arms.
+
+    Straggler resilience: the SAME 8-station host federation runs mean
+    rounds three ways — clean sync (all stations, wait=True), sync with a
+    V6T_FAULTS delay pinning station 0 at ~10x the clean round time
+    (every round waits for the straggler: rounds/sec craters toward
+    1/delay), and buffered-async via Federation.run_buffered (quorum 7,
+    over-select 1: first-7 completions aggregate, the straggler is
+    killed at quorum by the terminal-sticky kill_task). Acceptance:
+    async holds >= AP_RESILIENCE_PCT of clean sync rounds/sec, at
+    aggregate parity (the one excluded station moves an 8-station mean
+    well under 2%).
+
+    Autopilot smoke: a FedAvg engine run with FAULTS.poison_labels
+    label-flipping one station of 8 records into the learning plane; the
+    anomalous_station alert fires and the attached Autopilot
+    (ArrayActuator) auto-masks the station HANDS-OFF; re-running under
+    the actuator's participation mask recovers accuracy; clearing the
+    learning history clears the alert and the mask REVERTS. The flight
+    dump's doctor digest must show both the action and the revert.
+    """
+    _worker_setup()
+    import subprocess
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pandas as pd
+
+    from vantage6_tpu.algorithm.decorators import data
+    from vantage6_tpu.common.enums import TaskStatus
+    from vantage6_tpu.common.faults import FAULTS
+    from vantage6_tpu.common.flight import FLIGHT
+    from vantage6_tpu.core.mesh import FederationMesh
+    from vantage6_tpu.fed.fedavg import AsyncRoundSpec, FedAvg, FedAvgSpec
+    from vantage6_tpu.runtime.autopilot import ArrayActuator, Autopilot
+    from vantage6_tpu.runtime.federation import federation_from_datasets
+    from vantage6_tpu.runtime.learning import LEARNING
+    from vantage6_tpu.runtime.tracing import TRACER
+    from vantage6_tpu.runtime.watchdog import WATCHDOG
+
+    S = int(os.environ.get("BENCH_AP_STATIONS", str(AP_STATIONS)))
+    rounds = int(os.environ.get("BENCH_AP_ROUNDS", str(AP_ROUNDS)))
+
+    # ---- straggler arm ------------------------------------------------
+    @data(1)
+    def local_mean(df):
+        return {"sum": float(df["x"].sum()), "n": int(len(df))}
+
+    rng = np.random.default_rng(5)
+    frames = [
+        pd.DataFrame({"x": rng.normal(10.0, 1.0, 128)}) for _ in range(S)
+    ]
+    fed = federation_from_datasets(
+        frames, {"bench-ap": {"local_mean": local_mean}},
+        executor_workers=S,
+    )
+
+    def sync_round() -> float:
+        t = fed.create_task("bench-ap", {"method": "local_mean"})
+        rs = [
+            r.result for r in t.runs if r.status == TaskStatus.COMPLETED
+        ]
+        total = sum(r["sum"] for r in rs)
+        n = sum(r["n"] for r in rs)
+        return total / max(n, 1)
+
+    FAULTS.clear()
+    t0 = time.perf_counter()
+    vals_clean = [sync_round() for _ in range(rounds)]
+    clean_dt = time.perf_counter() - t0
+    rps_clean = rounds / clean_dt
+    # the "10x-slow station": pin the delay to ~9 extra clean-round times
+    # (clamped so degraded hosts still finish inside the leg timeout)
+    delay_s = min(1.0, max(0.2, 9.0 * clean_dt / rounds))
+    FAULTS.configure(f"delay:station=0,seconds={delay_s:.3f}")
+
+    sync_straggler_rounds = max(2, rounds // 3)
+    t0 = time.perf_counter()
+    for _ in range(sync_straggler_rounds):
+        sync_round()
+    rps_sync_straggler = sync_straggler_rounds / (
+        time.perf_counter() - t0
+    )
+
+    spec = AsyncRoundSpec(
+        quorum=S - 1, over_select=1, staleness_discount=0.5,
+        deadline_s=max(5.0, 4.0 * delay_s),
+    )
+    vals_async, killed_total, max_staleness = [], 0, 0.0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        res = fed.run_buffered(
+            "bench-ap", {"method": "local_mean"}, spec,
+            rng=np.random.default_rng(0),
+        )
+        accepted = set(res["accepted"])
+        rs = [
+            r.result for r in res["task"].runs
+            if r.station_index in accepted
+        ]
+        total = sum(r["sum"] for r in rs)
+        n = sum(r["n"] for r in rs)
+        vals_async.append(total / max(n, 1))
+        killed_total += len(res["killed"])
+        max_staleness = max(max_staleness, float(max(res["staleness"])))
+    rps_async = rounds / (time.perf_counter() - t0)
+    fault_snapshot = FAULTS.snapshot()
+    FAULTS.clear()
+    staleness_after = fed.station_staleness()
+    fed.close()
+
+    resilience = 100.0 * rps_async / rps_clean if rps_clean > 0 else 0.0
+    mean_clean = float(np.mean(vals_clean))
+    mean_async = float(np.mean(vals_async))
+    agg_rel_err = abs(mean_async - mean_clean) / max(abs(mean_clean), 1e-9)
+
+    # ---- autopilot closed-loop smoke ---------------------------------
+    TRACER.configure(enabled=True, sample=1.0)
+    WATCHDOG.configure(interval=OBS_WD_INTERVAL)
+    LEARNING.clear()
+    FLIGHT.clear()
+    S2, n_rows, d = 8, 32, 16
+    seeded = 5
+    rng2 = np.random.default_rng(7)
+    x = rng2.standard_normal((S2, n_rows, d)).astype(np.float32)
+    beta = rng2.standard_normal(d).astype(np.float32)
+    y_clean = (x @ beta + 0.05 * rng2.standard_normal(
+        (S2, n_rows)
+    )).astype(np.float32)
+    # the poisoning goes through the fault harness, not hand-rolled
+    # flipping: the same V6T_FAULTS spec a deployment would smoke with
+    FAULTS.configure(f"flip:station={seeded},fraction=1.0")
+    y = y_clean.copy()
+    y[seeded] = FAULTS.poison_labels(y[seeded], seeded)
+    flip_applied = not np.array_equal(y[seeded], y_clean[seeded])
+    FAULTS.clear()
+
+    def loss_fn(p, bx, by, w):
+        pred = bx @ p
+        return jnp.sum(w * (pred - by) ** 2) / jnp.maximum(
+            jnp.sum(w), 1.0
+        )
+
+    mesh = FederationMesh(S2)
+    eng = FedAvg(mesh, FedAvgSpec(
+        loss_fn=loss_fn, local_steps=2, batch_size=16, local_lr=0.02
+    ))
+    counts = jnp.full((S2,), float(n_rows))
+    p0 = jnp.zeros(d)
+    key = jax.random.key(3)
+    sm_rounds = 6
+    _, _, losses_poisoned, stats = eng.run_rounds(
+        p0, jnp.asarray(x), jnp.asarray(y), counts, key, sm_rounds,
+        donate=False,
+    )
+    _, _, losses_clean, _ = eng.run_rounds(
+        p0, jnp.asarray(x), jnp.asarray(y_clean), counts, key, sm_rounds,
+        donate=False,
+    )
+
+    actuator = ArrayActuator(S2)
+    pilot = Autopilot(actuator=actuator, listener_key="bench-autopilot")
+    pilot.attach()
+    WATCHDOG.start()
+    out_smoke: dict = {}
+    try:
+        history = LEARNING.history("bench-autopilot")
+        with TRACER.span("bench.autopilot_smoke", kind="bench"):
+            history.record_engine(losses_poisoned, stats)
+        recorded_at = time.monotonic()
+        deadline = recorded_at + 4 * OBS_WD_INTERVAL + 2.0
+        while time.monotonic() < deadline and not actuator.masked[seeded]:
+            time.sleep(0.05)
+        mask_detect_s = time.monotonic() - recorded_at
+        auto_masked = bool(actuator.masked[seeded])
+        # hands-off recovery: rerun under the mask the AUTOPILOT set
+        mask = jnp.asarray(actuator.participation_mask())
+        _, _, losses_masked, _ = eng.run_rounds(
+            p0, jnp.asarray(x), jnp.asarray(y), counts, key, sm_rounds,
+            mask=mask, donate=False,
+        )
+        # alert clear -> revert: with the poisoned history gone the
+        # anomalous_station rule proposes nothing and the engaged mask
+        # must come back off by itself
+        LEARNING.clear()
+        revert_deadline = time.monotonic() + 4 * OBS_WD_INTERVAL + 2.0
+        while (
+            time.monotonic() < revert_deadline and actuator.masked[seeded]
+        ):
+            time.sleep(0.05)
+        mask_reverted = not bool(actuator.masked[seeded])
+        digest = pilot.digest()
+        dump_path = FLIGHT.dump(reason="bench-autopilot")
+        doctor = subprocess.run(
+            [sys.executable, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "doctor.py",
+            ), dump_path, "--tail", "0"],
+            capture_output=True, text=True, timeout=60,
+        )
+        poisoned_loss = float(np.asarray(losses_poisoned)[-1])
+        masked_loss = float(np.asarray(losses_masked)[-1])
+        clean_loss = float(np.asarray(losses_clean)[-1])
+        out_smoke = {
+            "flip_applied": flip_applied,
+            "seeded_station": seeded,
+            "autopilot_auto_masked": auto_masked,
+            "autopilot_mask_detect_s": round(mask_detect_s, 2),
+            "mask_detect_budget_s": round(2 * OBS_WD_INTERVAL + 0.5, 2),
+            "poisoned_final_loss": round(poisoned_loss, 5),
+            "masked_final_loss": round(masked_loss, 5),
+            "clean_final_loss": round(clean_loss, 5),
+            "accuracy_recovers": bool(
+                masked_loss < poisoned_loss
+                and masked_loss <= max(clean_loss * 1.5, clean_loss + 0.05)
+            ),
+            "mask_reverted_on_clear": mask_reverted,
+            "autopilot_digest": digest,
+            "flight_bundle": dump_path,
+            "doctor_shows_action_and_revert": bool(
+                doctor.returncode == 0
+                and "autopilot digest" in doctor.stdout
+                and "mask_station" in doctor.stdout
+                and "reverted" in doctor.stdout
+            ),
+        }
+    finally:
+        pilot.detach()
+        WATCHDOG.stop()
+        FAULTS.clear()
+
+    print(json.dumps({
+        "n_stations": S,
+        "rounds": rounds,
+        "straggler_delay_s": round(delay_s, 3),
+        "clean_rounds_per_sec": round(rps_clean, 3),
+        "sync_straggler_rounds_per_sec": round(rps_sync_straggler, 3),
+        "async_rounds_per_sec": round(rps_async, 3),
+        "straggler_resilience_pct": round(resilience, 1),
+        "resilience_ok": bool(resilience >= AP_RESILIENCE_PCT),
+        "sync_craters": bool(rps_sync_straggler <= 0.5 * rps_clean),
+        "stragglers_killed": killed_total,
+        "straggler_max_staleness": max_staleness,
+        "staleness_after": [int(v) for v in staleness_after],
+        "aggregate_rel_err": round(agg_rel_err, 5),
+        "aggregate_parity_ok": bool(agg_rel_err < 0.02),
+        "fault_snapshot": fault_snapshot,
+        **out_smoke,
+    }))
+
+
 def worker_baseline() -> None:
     """Reference-shaped rounds: sequential stations + JSON payload hops.
 
@@ -2791,6 +3055,23 @@ def main() -> None:
     legs_done.append(leg_marker("compression", cx, cx_diag))
     emit()
 
+    # ---- robustness: buffered-async + autopilot loop ------------------
+    # CPU by design: host-plane scheduling (straggler kill at quorum) and
+    # a small CPU engine for the closed-loop mask smoke — nothing here
+    # measures device throughput.
+    ap, ap_diag = (None, f"skipped: {remaining():.0f}s left in budget")
+    if remaining() > MIN_LEG_S:
+        ap, ap_diag = _run_worker(
+            "autopilot", force_cpu=True,
+            timeout_s=leg_timeout(AP_TIMEOUT_S),
+        )
+    if ap is not None:
+        out["autopilot"] = ap
+    else:
+        out["autopilot_error"] = ap_diag
+    legs_done.append(leg_marker("autopilot", ap, ap_diag))
+    emit()
+
     # ---- MXU utilization metric (transformer) -------------------------
     tf, tf_diag = (None, f"skipped: {remaining():.0f}s left in budget")
     if remaining() > MIN_LEG_S:
@@ -2935,6 +3216,7 @@ if __name__ == "__main__":
          "observability": worker_observability,
          "wireformat": worker_wireformat,
          "compression": worker_compression,
+         "autopilot": worker_autopilot,
          "transformer": worker_transformer,
          "fedoverhead": worker_fedoverhead}[sys.argv[2]]()
     else:

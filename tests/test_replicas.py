@@ -346,3 +346,40 @@ def test_fedavg_round_trajectory_spans_replicas(pair, tmp_path):
         http_a.stop()
         http_b.stop()
         LEARNING.clear()
+
+
+def test_autopilot_requeue_exactly_once_across_replicas(pair):
+    """Satellite (ISSUE 15): both replicas' autopilots remediate the SAME
+    daemon_lapsed alert concurrently — the CAS guard inside
+    ServerActuator._requeue lets exactly one of them re-queue the
+    orphaned ACTIVE run; the loser's swap fails and it reports 0."""
+    from vantage6_tpu.server.app import ServerActuator
+
+    a, b = pair
+    s = _seed(a)
+    run_id = s["run"]["id"]
+    node_id = s["node"]["id"]
+    # the daemon activated the run, then lapsed mid-execution
+    na = _node(a, s["node"]["api_key"])
+    assert na.patch(f"/api/run/{run_id}", {"status": "active"}).status == 200
+    actuators = [ServerActuator(a), ServerActuator(b)]
+    results = [None, None]
+    barrier = threading.Barrier(2)
+
+    def remediate(i):
+        barrier.wait()
+        results[i] = actuators[i].requeue_node_runs(node_id)
+
+    threads = [
+        threading.Thread(target=remediate, args=(i,)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == [0, 1], results
+    run = m.TaskRun.get(run_id)
+    assert run.status == "pending"
+    assert "re-queued by autopilot" in (run.log or "")
+    # remediating again finds nothing ACTIVE: the action is idempotent
+    assert actuators[0].requeue_node_runs(node_id) == 0

@@ -76,6 +76,12 @@ HEADLINES: list[tuple[str, str, str]] = [
     # sharding regression shows as a falling ratio, not just a slower leg
     ("mfu_vs_v5e_bf16_peak", "higher", "spmd"),
     ("transformer_mfu_vs_v5e_bf16_peak", "higher", "transformer"),
+    # robustness (buffered-async + autopilot PR): fraction of no-straggler
+    # sync throughput the buffered-async round keeps with one 10x-slow
+    # station (acceptance floor 80%), and how fast the autopilot masks a
+    # label-flip-poisoned station hands-off
+    ("straggler_resilience_pct", "higher", "autopilot"),
+    ("autopilot_mask_detect_s", "lower", "autopilot"),
 ]
 
 _NUM_RE = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"

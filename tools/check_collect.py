@@ -361,6 +361,72 @@ def check_alert_rules() -> list[str]:
     return problems
 
 
+def check_autopilot() -> list[str]:
+    """Audit the autopilot policy surface (runtime/autopilot.py,
+    docs/OPERATOR_GUIDE.md "autopilot"):
+
+    - every default policy names a watchdog rule that exists in
+      RULE_CATALOG — a policy keyed to a renamed rule would never fire
+      and the closed loop silently opens;
+    - every metric a policy declares is in KNOWN_METRICS with the
+      ``v6t_autopilot_`` prefix, and the declared-vs-emitted literal
+      scan holds both directions (same drift gate as the device
+      observatory and learning plane).
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import KNOWN_METRICS
+        from vantage6_tpu.runtime.autopilot import DEFAULT_POLICIES
+        from vantage6_tpu.runtime.watchdog import RULE_CATALOG
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import the autopilot surface: {e!r}"]
+    declared_all = {name for name, _kind, _help in KNOWN_METRICS}
+    for policy in DEFAULT_POLICIES:
+        if policy.rule not in RULE_CATALOG:
+            problems.append(
+                f"autopilot policy for rule {policy.rule!r} names a rule "
+                "missing from RULE_CATALOG (runtime/watchdog.py) — it can "
+                "never fire"
+            )
+        for metric in policy.metrics:
+            if not metric.startswith("v6t_autopilot_"):
+                problems.append(
+                    f"autopilot policy {policy.rule!r} declares metric "
+                    f"{metric!r} outside the v6t_autopilot_ namespace"
+                )
+            if metric not in declared_all:
+                problems.append(
+                    f"autopilot policy {policy.rule!r} declares metric "
+                    f"{metric!r} not in KNOWN_METRICS (common/telemetry.py)"
+                )
+    path = os.path.join(
+        _REPO_ROOT, "vantage6_tpu", "runtime", "autopilot.py"
+    )
+    try:
+        source = open(path).read()
+    except OSError as e:
+        return problems + [f"cannot read runtime/autopilot.py: {e}"]
+    declared = {
+        name for name in declared_all if name.startswith("v6t_autopilot_")
+    }
+    # `+` not `*`: the bare "v6t_autopilot_" prefix literal (the policy
+    # validator's namespace check) is not a metric name
+    emitted = set(re.findall(r'"(v6t_autopilot_[a-z0-9_]+)"', source))
+    for name in sorted(declared - emitted):
+        problems.append(
+            f"metric {name!r} declared in KNOWN_METRICS but never emitted "
+            "by runtime/autopilot.py"
+        )
+    for name in sorted(emitted - declared):
+        problems.append(
+            f"runtime/autopilot.py emits {name!r} which is not declared "
+            "in KNOWN_METRICS (common/telemetry.py)"
+        )
+    return problems
+
+
 def check_storage_backend() -> list[str]:
     """Audit the shared-store surface (server/db.py, server/pubsub.py,
     docs/control_plane.md "running N replicas"):
@@ -681,6 +747,21 @@ def main(argv: list[str]) -> int:
         for p in learning_problems:
             sys.stderr.write(f"  {p}\n")
         return 1
+
+    autopilot_problems = check_autopilot()
+    if autopilot_problems:
+        sys.stderr.write(
+            "AUTOPILOT DRIFT: the policy table, RULE_CATALOG, or the "
+            "v6t_autopilot_* metric surface disagree "
+            "(docs/OPERATOR_GUIDE.md 'autopilot'):\n"
+        )
+        for p in autopilot_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+    print(
+        "autopilot audit ok: policies cataloged, v6t_autopilot_* "
+        "declared <-> emitted"
+    )
 
     backend_problems = check_storage_backend()
     if backend_problems:

@@ -371,6 +371,76 @@ def render_learning(learning: dict[str, Any]) -> list[str]:
     return lines
 
 
+def autopilot_digest(
+    records: list[dict[str, Any]],
+) -> dict[str, Any] | None:
+    """Remediation history of the bundle (runtime/autopilot.py,
+    docs/OPERATOR_GUIDE.md "autopilot"): every action the policy engine
+    took — or would have taken, in dry-run — and which were reverted
+    when their alert cleared. None when the bundle has no autopilot
+    notes (engine not enabled, or nothing fired)."""
+    actions: list[dict[str, Any]] = []
+    reverts: list[dict[str, Any]] = []
+    for rec in records:
+        if rec.get("type") != "note":
+            continue
+        entry = {
+            "ts": rec.get("ts"),
+            "rule": rec.get("rule"),
+            "action": rec.get("action"),
+            "labels": rec.get("labels") or {},
+            "detail": rec.get("detail"),
+            "dry_run": bool(rec.get("dry_run")),
+            "trace_id": _trace_of(rec),
+        }
+        if rec.get("kind") == "autopilot_action":
+            actions.append(entry)
+        elif rec.get("kind") == "autopilot_revert":
+            reverts.append(entry)
+    if not actions and not reverts:
+        return None
+    live = [a for a in actions if not a["dry_run"]]
+    dry = [a for a in actions if a["dry_run"]]
+    by_rule: dict[str, int] = {}
+    for a in live:
+        by_rule[str(a["rule"])] = by_rule.get(str(a["rule"]), 0) + 1
+    return {
+        "actions_taken": len(live),
+        "reverted": len(reverts),
+        "dry_run_suppressed": len(dry),
+        "by_rule": by_rule,
+        "actions": actions,
+        "reverts": reverts,
+    }
+
+
+def render_autopilot(ap: dict[str, Any]) -> list[str]:
+    lines = [
+        "\nautopilot digest:",
+        f"  {ap['actions_taken']} action(s) taken, "
+        f"{ap['reverted']} reverted, "
+        f"{ap['dry_run_suppressed']} dry-run suppressed",
+    ]
+    for rule, n in sorted(ap["by_rule"].items()):
+        lines.append(f"  {rule}: {n} action(s)")
+    for a in ap["actions"]:
+        labels = ",".join(f"{k}={v}" for k, v in sorted(a["labels"].items()))
+        suffix = " [dry-run]" if a["dry_run"] else ""
+        lines.append(
+            f"    {a['rule']} -> {a['action']}"
+            + (f" ({labels})" if labels else "") + suffix
+        )
+        if a["trace_id"]:
+            lines.append(f"      trace: {a['trace_id']}")
+    for r in ap["reverts"]:
+        labels = ",".join(f"{k}={v}" for k, v in sorted(r["labels"].items()))
+        lines.append(
+            f"    reverted: {r['rule']} -> {r['action']}"
+            + (f" ({labels})" if labels else "")
+        )
+    return lines
+
+
 def timeline(
     records: list[dict[str, Any]],
     trace: str | None = None,
@@ -472,6 +542,7 @@ def main(argv: list[str]) -> int:
     alerts = alert_digest(records)
     perf = perf_digest(records)
     learning = learning_digest(records, alerts)
+    autopilot = autopilot_digest(records)
     rows = timeline(records, trace=args.trace, window=args.window)
     if args.tail and len(rows) > args.tail:
         clipped, rows = len(rows) - args.tail, rows[-args.tail:]
@@ -488,6 +559,7 @@ def main(argv: list[str]) -> int:
             "alerts": alerts,
             "perf": perf,
             "learning": learning,
+            "autopilot": autopilot,
             "timeline": rows,
             "clipped": clipped,
         }, indent=2, default=str))
@@ -520,6 +592,9 @@ def main(argv: list[str]) -> int:
             print(line)
     if learning:
         for line in render_learning(learning):
+            print(line)
+    if autopilot:
+        for line in render_autopilot(autopilot):
             print(line)
     print(
         f"\ntimeline ({len(rows)} records"

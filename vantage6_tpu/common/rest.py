@@ -272,6 +272,15 @@ class RestSession:
         """JSON request/response; ``raw=True`` returns the response body
         as text instead (non-JSON endpoints: /api/metrics Prometheus
         exposition)."""
+        # fault injection (V6T_FAULTS rest500): fail the request before it
+        # touches the wire, so retry/rotation paths see a real RestError
+        from vantage6_tpu.common.faults import FAULTS
+
+        injected = FAULTS.rest_status(endpoint)
+        if injected:
+            raise RestError(
+                injected, f"injected fault (V6T_FAULTS rest500) on {endpoint}"
+            )
         headers = {}
         token = self._token_getter()
         if token:

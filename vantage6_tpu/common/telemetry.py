@@ -152,6 +152,25 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
     # node daemon resilience (node.daemon)
     ("v6t_daemon_backoff_total", "counter",
      "event-poll failures that entered the capped exponential backoff"),
+    ("v6t_daemon_rotation_total", "counter",
+     "full replica-URL rotations that found no reachable server (each "
+     "enters the capped jittered backoff)"),
+    # async buffered aggregation (runtime.federation.run_buffered)
+    ("v6t_async_rounds_total", "counter",
+     "buffered-async federated rounds orchestrated"),
+    ("v6t_async_stragglers_killed_total", "counter",
+     "straggler runs killed at quorum/deadline by buffered-async rounds"),
+    # autopilot remediation engine (runtime.autopilot —
+    # docs/OPERATOR_GUIDE.md "autopilot")
+    ("v6t_autopilot_actions_total", "counter",
+     "remediation actions applied by the autopilot"),
+    ("v6t_autopilot_reverts_total", "counter",
+     "autopilot actions reverted on alert clear"),
+    ("v6t_autopilot_suppressed_total", "counter",
+     "autopilot actions suppressed by dry-run mode or a missing actuator "
+     "capability"),
+    ("v6t_autopilot_engaged", "gauge",
+     "autopilot actions currently applied and not yet reverted"),
     # flight recorder (common.flight)
     ("v6t_flight_records", "gauge",
      "entries currently buffered across the flight-recorder rings"),

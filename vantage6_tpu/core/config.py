@@ -117,6 +117,15 @@ class FederationConfig:
     # (large models on a real pod), same stance as
     # FedAvgSpec.learning_stats.
     learning_stats: bool = True
+    # Autopilot remediation engine (runtime.autopilot —
+    # docs/OPERATOR_GUIDE.md "autopilot"): when `enabled`, the Federation
+    # attaches an Autopilot to the process watchdog with itself as the
+    # actuator (mask / selection-weight / admission capabilities). Keys:
+    #   enabled: bool (default False — opt in per federation)
+    #   dry_run: bool (log + count decisions, touch nothing)
+    #   disable: [rule names] (turn individual policies off)
+    #   straggler_weight: float (shrunk selection weight, default 0.25)
+    autopilot: dict[str, Any] | None = None
     stations: list[StationConfig] = dataclasses.field(default_factory=list)
     server: dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -148,6 +157,20 @@ class FederationConfig:
                 validate()
             except ValueError as e:
                 raise ConfigurationError(f"bad compressor: {e}") from e
+        if self.autopilot is not None:
+            if not isinstance(self.autopilot, dict):
+                raise ConfigurationError(
+                    "federation.autopilot must be a mapping "
+                    "(enabled/dry_run/disable/straggler_weight), got "
+                    f"{self.autopilot!r}"
+                )
+            allowed = {"enabled", "dry_run", "disable", "straggler_weight"}
+            unknown = set(self.autopilot) - allowed
+            if unknown:
+                raise ConfigurationError(
+                    "federation.autopilot: unknown key(s) "
+                    f"{sorted(unknown)} (expected {sorted(allowed)})"
+                )
         names = [s.name for s in self.stations]
         if len(names) != len(set(names)):
             raise ConfigurationError("duplicate station names")
@@ -215,6 +238,7 @@ class FederationConfig:
             devices_per_station=int(fed.get("devices_per_station", 1)),
             executor_workers=None if workers is None else int(workers),
             compressor=compressor,
+            autopilot=fed.get("autopilot"),
             stations=stations,
             server=raw.get("server", {}) or {},
         )
@@ -236,6 +260,10 @@ class FederationConfig:
                 "encrypted": self.encrypted,
                 "devices_per_station": self.devices_per_station,
                 "executor_workers": self.executor_workers,
+                **(
+                    {"autopilot": self.autopilot}
+                    if self.autopilot is not None else {}
+                ),
             },
             "server": self.server,
             "stations": [

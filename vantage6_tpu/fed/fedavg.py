@@ -88,6 +88,57 @@ class FedAvgSpec:
     learning_stats: bool = True
 
 
+@dataclasses.dataclass(frozen=True)
+class AsyncRoundSpec:
+    """FedBuff-style buffered-async round shape (Nguyen et al. 2022).
+
+    The server dispatches ``quorum + over_select`` stations, aggregates
+    the FIRST ``quorum`` results to arrive, and kills whatever is still
+    running at quorum (or at ``deadline_s``, whichever comes first).
+    Non-accepted stations accrue **staleness**: when a stale station's
+    update finally lands in a later round, it participates discounted by
+    ``staleness_discount ** staleness`` — the standard FedBuff weighting
+    that keeps slow-but-honest contributors in the model without letting
+    their stale gradients drag it backwards.
+
+    The discount rides the existing participation-mask seam
+    (:meth:`FedAvg.async_round` folds it into ``mask``), so the jitted
+    round program is byte-identical to the synchronous one: compression
+    error-feedback still waits on mask==0 stations, learning stats stay
+    participation-aware, and no new traced signature is introduced.
+    """
+
+    quorum: int                      # K: accept the first K results
+    over_select: int = 1             # m: dispatch K + m stations
+    staleness_discount: float = 0.5  # weight multiplier per round of staleness
+    deadline_s: float = 30.0         # hard per-round wall-clock cap
+
+    def validate(self) -> None:
+        if self.quorum < 1:
+            raise ValueError("AsyncRoundSpec.quorum must be >= 1")
+        if self.over_select < 0:
+            raise ValueError("AsyncRoundSpec.over_select must be >= 0")
+        if not (0.0 < self.staleness_discount <= 1.0):
+            raise ValueError(
+                "AsyncRoundSpec.staleness_discount must be in (0, 1]"
+            )
+        if self.deadline_s <= 0:
+            raise ValueError("AsyncRoundSpec.deadline_s must be > 0")
+
+    @property
+    def n_select(self) -> int:
+        return self.quorum + self.over_select
+
+    def staleness_weights(self, staleness: Any) -> jax.Array:
+        """Per-station multiplicative discount ``discount ** staleness``
+        for a ``[S]`` staleness vector (rounds since the station last
+        contributed an accepted update)."""
+        return jnp.power(
+            jnp.asarray(self.staleness_discount, jnp.float32),
+            jnp.asarray(staleness, jnp.float32),
+        )
+
+
 class FedAvg:
     """Compiles and runs federated-averaging rounds on a FederationMesh."""
 
@@ -356,6 +407,44 @@ class FedAvg:
         )
         self._record_history(out[2], out[3])
         return out
+
+    def async_round(
+        self,
+        params: Pytree,
+        opt_state: Any,
+        stacked_x: jax.Array,
+        stacked_y: jax.Array,
+        counts: jax.Array,
+        key: jax.Array,
+        accept_mask: jax.Array,
+        staleness: jax.Array,
+        spec: AsyncRoundSpec,
+        mask: jax.Array | None = None,
+    ):
+        """One buffered-async round: only ``accept_mask`` stations (the
+        first-K arrivals, from ``Federation.run_buffered`` or a
+        simulator) contribute, each discounted by
+        ``spec.staleness_discount ** staleness``.
+
+        Implemented entirely at the participation-mask seam — the
+        effective mask is ``mask * accept_mask * discount`` and feeds the
+        SAME jitted round program as :meth:`round` (``weights = counts *
+        mask`` inside ``_round_impl``), so nothing retraces and
+        compression EF / learning stats compose unchanged. A fractional
+        mask weights the aggregation; EF-wait and stats participation key
+        on ``mask != 0``, which is exactly "the station shipped an
+        update this round"."""
+        spec.validate()
+        effective = (
+            jnp.asarray(accept_mask, jnp.float32)
+            * spec.staleness_weights(staleness)
+        )
+        if mask is not None:
+            effective = effective * jnp.asarray(mask, jnp.float32)
+        return self.round(
+            params, opt_state, stacked_x, stacked_y, counts, key,
+            mask=effective,
+        )
 
     def _record_wire(self, params: Pytree, n_rounds: int = 1) -> None:
         """Host-side wire accounting for the compressed delta uplink

@@ -276,6 +276,11 @@ class NodeDaemon:
         )
         self._long_poll: bool | None = None
         self._poll_failures = 0
+        # consecutive full replica-URL rotations that found NO reachable
+        # server (reset on any success): drives the capped jittered
+        # backoff between sweeps, so N daemons that lost the whole
+        # control plane re-probe decorrelated instead of in lockstep
+        self._rotation_streak = 0
         self._reporter = _BatchReporter(self)
         # run_id -> claim-batch entry (run dict + embedded task +
         # container token): what a batched claim prefetched so _execute
@@ -406,20 +411,70 @@ class NodeDaemon:
         failure (socket refused/reset/timed out — the server process is
         gone) rotates to the next replica URL and retries, once per
         configured replica. HTTP-level errors (RestError) pass through
-        untouched: the server answered, the replica is fine."""
+        untouched: the server answered, the replica is fine.
+
+        A FULL failed rotation (every replica refused) means the whole
+        control plane is gone, not one process — the daemon backs off
+        with capped jitter (same `backoff_delay` as the event poll,
+        streak persisted across calls) and makes one more sweep before
+        raising, so a fleet that lost all replicas at once re-probes
+        decorrelated."""
+        if len(self.api_urls) == 1:
+            # single-URL daemons keep the historical fail-fast contract;
+            # the event poll's own backoff paces the retries
+            return self._rest.request(
+                method, endpoint, json_body, params, timeout=timeout
+            )
         last_exc: Exception | None = None
-        for _ in range(len(self.api_urls)):
-            try:
-                return self._rest.request(
-                    method, endpoint, json_body, params, timeout=timeout
-                )
-            except RestError:
-                raise
-            except OSError as e:
-                last_exc = e
-                if len(self.api_urls) == 1:
+        for sweep in range(2):
+            for _ in range(len(self.api_urls)):
+                try:
+                    result = self._rest.request(
+                        method, endpoint, json_body, params, timeout=timeout
+                    )
+                except RestError:
                     raise
-                self._rotate_replica(e)
+                except OSError as e:
+                    last_exc = e
+                    self._rotate_replica(e)
+                    continue
+                if self._rotation_streak:
+                    log.info(
+                        "control plane reachable again after %d failed "
+                        "rotation(s)", self._rotation_streak,
+                    )
+                    self._rotation_streak = 0
+                return result
+            assert last_exc is not None
+            self._rotation_streak += 1
+            delay = backoff_delay(
+                max(self.poll_interval, 0.05), self._rotation_streak,
+                cap=5.0,
+            )
+            from vantage6_tpu.common.flight import FLIGHT
+            from vantage6_tpu.common.telemetry import REGISTRY
+
+            REGISTRY.counter("v6t_daemon_rotation_total").inc()
+            FLIGHT.note(
+                "replica_rotation_failed", attempt=self._rotation_streak,
+                replicas=len(self.api_urls), retry_in_s=round(delay, 3),
+                error=str(last_exc),
+            )
+            # one warning per streak (the _poll_once convention): entry
+            # at WARNING, the rest at DEBUG, recovery at INFO above
+            if self._rotation_streak == 1:
+                log.warning(
+                    "all %d replica URLs unreachable; backing off %.2fs "
+                    "before re-sweep (further rotations logged at DEBUG): "
+                    "%s", len(self.api_urls), delay, last_exc,
+                )
+            else:
+                log.debug(
+                    "full rotation %d failed (retry in %.2fs): %s",
+                    self._rotation_streak, delay, last_exc,
+                )
+            if sweep == 0:
+                self._stop.wait(delay)
         assert last_exc is not None
         raise last_exc
 
@@ -582,6 +637,19 @@ class NodeDaemon:
         self._listen()
         return self
 
+    def crash(self) -> None:
+        """Simulate a hard process death (V6T_FAULTS `crash`): every
+        worker stops but the node is NEVER patched offline — the server
+        only learns through its `daemon_lapsed` watchdog rule, exactly
+        like a real SIGKILL mid-round. Used by the fault-injection
+        harness; see docs/OPERATOR_GUIDE.md "autopilot"."""
+        self._stop.set()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self._reporter.stop()
+        if self._proxy_server:
+            self._proxy_server.stop()
+            self._proxy_server = None
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
@@ -605,9 +673,18 @@ class NodeDaemon:
     def _listen(self) -> None:
         """Prefer websocket push (SocketIO parity); the REST cursor remains
         the fallback AND the gap-filler after any socket drop."""
+        from vantage6_tpu.common.faults import FAULTS
+
         discover_at = 0.0
         ws_url: str | None = None
         while not self._stop.is_set():
+            if FAULTS.daemon_crash():
+                log.error(
+                    "injected daemon crash (V6T_FAULTS): dying without "
+                    "the offline handshake"
+                )
+                self.crash()
+                return
             now = time.monotonic()
             if now >= discover_at:
                 ws_url = self._discover_ws()

@@ -77,6 +77,19 @@ class Federation:
             for i, s in enumerate(config.stations)
         ]
         self._online = [True] * config.n_stations
+        # -------------------------------------------- autopilot actuator state
+        # masked: autopilot (or operator) exclusion from selection AND the
+        # participation mask — an anomalous station keeps its runs but its
+        # results carry zero aggregate weight. selection weights bias
+        # run_buffered's over-selection away from stragglers. staleness
+        # counts rounds since a station last landed an accepted update
+        # (run_buffered credit; AsyncRoundSpec discounts on it). The
+        # admission flag makes _dispatch queue host runs instead of
+        # submitting (queue_buildup remediation).
+        self._masked = [False] * config.n_stations
+        self._selection_weights = [1.0] * config.n_stations
+        self._staleness = [0] * config.n_stations
+        self._admission_limited = False
         # per-station LOCAL secrets (DH mask agreement, secureagg_dh):
         # generated here exactly as each real node would generate its own;
         # central/aggregator code has no accessor — partials reach their own
@@ -173,6 +186,26 @@ class Federation:
 
         self._watchdog_feed_fn = _feed
         WATCHDOG.register_feed(key, _feed)
+        # ------------------------------------------------------- autopilot
+        # opt-in closed-loop remediation (config.autopilot.enabled): the
+        # Federation is its own actuator — mask_station /
+        # set_selection_weight / set_admission_limited below. close()
+        # detaches the listener.
+        self.autopilot = None
+        ap_cfg = dict(config.autopilot or {})
+        if ap_cfg.get("enabled"):
+            from vantage6_tpu.runtime.autopilot import Autopilot
+
+            self.autopilot = Autopilot(
+                actuator=self,
+                dry_run=ap_cfg.get("dry_run"),
+                disable=set(ap_cfg.get("disable") or ()),
+                config={
+                    k: v for k, v in ap_cfg.items()
+                    if k not in ("enabled", "dry_run", "disable")
+                },
+                listener_key=f"autopilot-{key}",
+            ).attach()
 
     # ------------------------------------------------------------------ data
     def load_all_data(self) -> None:
@@ -273,7 +306,52 @@ class Federation:
             self._drain_pending(station)
 
     def participation_mask(self) -> jnp.ndarray:
-        return jnp.asarray(self._online, jnp.float32)
+        """1.0 for stations that may contribute to aggregates: online AND
+        not masked out by the autopilot/operator."""
+        return jnp.asarray(
+            [
+                1.0 if (on and not masked) else 0.0
+                for on, masked in zip(self._online, self._masked)
+            ],
+            jnp.float32,
+        )
+
+    # ------------------------------------------------- autopilot capabilities
+    # The duck-typed actuator surface runtime.autopilot probes (the engine
+    # skips policies whose capability is absent). All are also callable by
+    # operators directly.
+    def mask_station(self, station: int, masked: bool = True) -> None:
+        """Exclude (or re-include) a station from `participation_mask` and
+        from run_buffered selection — the anomalous_station remediation.
+        Its runs still execute; their results just carry zero weight."""
+        self._masked[station] = bool(masked)
+
+    def set_selection_weight(self, station: int, weight: float) -> None:
+        """Bias run_buffered's weighted over-selection — the
+        straggler_station remediation shrinks this toward 0 (never to 0:
+        selection keeps a floor so the station can redeem itself)."""
+        if weight < 0:
+            raise ValueError("selection weight must be >= 0")
+        self._selection_weights[station] = float(weight)
+
+    def set_admission_limited(self, limited: bool) -> None:
+        """Admission control (queue_buildup remediation): when limited,
+        newly created host runs stay PENDING instead of dispatching onto
+        the executor. Lifting the limit drains everything queued."""
+        was = self._admission_limited
+        self._admission_limited = bool(limited)
+        if was and not limited:
+            for station in range(self.n_stations):
+                if self._online[station]:
+                    self._drain_pending(station, wait=False)
+
+    def selection_weights(self) -> list[float]:
+        return list(self._selection_weights)
+
+    def station_staleness(self) -> list[int]:
+        """Rounds since each station last landed an accepted update in a
+        buffered-async round (0 = accepted last round / never selected)."""
+        return list(self._staleness)
 
     # ----------------------------------------------------------------- tasks
     # --------------------------------------------------------------- sessions
@@ -419,6 +497,164 @@ class Federation:
         for r in self.tasks[task_id].runs:
             r.kill()
 
+    # --------------------------------------------- buffered-async rounds
+    def select_stations(
+        self,
+        n: int,
+        rng: np.random.Generator | None = None,
+        pool: list[int] | None = None,
+    ) -> list[int]:
+        """Weighted sample (without replacement) of ``n`` eligible
+        stations — online, not masked, optionally restricted to ``pool``
+        — proportional to their selection weights. The autopilot's
+        straggler remediation shrinks a weight; a shrunken station is
+        still selectable (it can redeem itself), just rarely. Seed the
+        generator for deterministic rounds."""
+        rng = rng if rng is not None else np.random.default_rng()
+        candidates = [
+            i for i in (pool if pool is not None else range(self.n_stations))
+            if self._online[i] and not self._masked[i]
+        ]
+        if not candidates:
+            raise RuntimeError(
+                "no eligible stations (all offline or masked)"
+            )
+        if n >= len(candidates):
+            return candidates
+        weights = np.asarray(
+            [self._selection_weights[i] for i in candidates], np.float64
+        )
+        # a zero-weight station stays reachable when nothing else is; the
+        # tiny floor keeps the distribution valid without letting a
+        # shrunken straggler outdraw healthy peers
+        weights = np.maximum(weights, 1e-9)
+        chosen = rng.choice(
+            len(candidates), size=n, replace=False, p=weights / weights.sum()
+        )
+        return sorted(candidates[int(j)] for j in chosen)
+
+    def run_buffered(
+        self,
+        image: str,
+        input_: dict[str, Any],
+        spec: Any,  # fed.fedavg.AsyncRoundSpec (duck-typed: core stays light)
+        organizations: list[int] | None = None,
+        rng: np.random.Generator | None = None,
+        name: str = "async_round",
+        databases: list[dict[str, Any]] | None = None,
+        parent: "Task | None" = None,
+        interval: float = 0.01,
+    ) -> dict[str, Any]:
+        """One FedBuff-style buffered round (tentpole layer a): dispatch
+        ``spec.quorum + spec.over_select`` stations, accept the FIRST
+        ``quorum`` completions, kill whatever is still running at quorum
+        or at ``spec.deadline_s`` — via the existing `kill_task`, whose
+        per-run kills are no-ops on completed runs (terminal-sticky Run
+        transitions make over-kill safe) — and credit staleness: accepted
+        stations reset to 0, selected-but-not-accepted stations +1.
+
+        Returns a dict with the finished ``task``, ``accepted`` /
+        ``killed`` station lists, an ``accept_mask`` [S] float array and
+        the pre-credit ``staleness`` [S] array — exactly the
+        ``FedAvg.async_round(accept_mask=..., staleness=...)`` inputs, so
+        masks, compression EF and learning stats compose through the
+        unchanged jitted round.
+
+        Over-selection rides the normal dispatch (and, in the daemon
+        topology, claim-batch) unchanged: the extra ``over_select`` runs
+        are ordinary runs that happen to get killed late.
+        """
+        spec.validate()
+        rng = rng if rng is not None else np.random.default_rng()
+        selected = self.select_stations(
+            spec.n_select, rng=rng, pool=organizations
+        )
+        quorum = min(spec.quorum, len(selected))
+        t0 = time.monotonic()
+        with TRACER.span(
+            "async.round", kind="dispatch", service="federation",
+            attrs={
+                "quorum": quorum, "selected": len(selected),
+                "deadline_s": spec.deadline_s,
+            },
+        ):
+            task = self.create_task(
+                image, input_, organizations=selected, name=name,
+                databases=databases, parent=parent, wait=False,
+            )
+            deadline = t0 + spec.deadline_s
+            while True:
+                done = [
+                    r for r in task.runs
+                    if r.status == TaskStatus.COMPLETED
+                ]
+                if len(done) >= quorum:
+                    break
+                if time.monotonic() >= deadline:
+                    break
+                if not self._runs_in_flight(task.runs):
+                    # nothing left running (failures / offline stations):
+                    # waiting out the deadline would buy nothing
+                    break
+                step = max(1e-3, min(interval, deadline - time.monotonic()))
+                if self._executor is not None:
+                    self._executor.help_or_wait(step)
+                else:
+                    time.sleep(step)
+            # first-K by completion time IS the buffer: a run completing
+            # after the quorum snapshot still exists, it just isn't in
+            # this round's aggregate
+            done.sort(key=lambda r: (r.finished_at or 0.0, r.id))
+            accepted = done[:quorum]
+            # kill_task, not per-run surgery: terminal-sticky transitions
+            # keep every COMPLETED run completed; only live stragglers
+            # flip to KILLED
+            self.kill_task(task.id)
+        killed = [
+            r.station_index for r in task.runs
+            if r.status == TaskStatus.KILLED
+        ]
+        accepted_stations = sorted(r.station_index for r in accepted)
+        accepted_set = set(accepted_stations)
+        # staleness snapshot BEFORE credit: this round's accepted updates
+        # are discounted by how long their stations were absent
+        staleness = np.asarray(self._staleness, np.float32)
+        for st in selected:
+            self._staleness[st] = (
+                0 if st in accepted_set else self._staleness[st] + 1
+            )
+        accept_mask = np.zeros(self.n_stations, np.float32)
+        for st in accepted_stations:
+            accept_mask[st] = 1.0
+        from vantage6_tpu.common.telemetry import REGISTRY
+
+        REGISTRY.counter("v6t_async_rounds_total").inc()
+        if killed:
+            REGISTRY.counter("v6t_async_stragglers_killed_total").inc(
+                len(killed)
+            )
+        try:
+            from vantage6_tpu.common.flight import FLIGHT
+
+            FLIGHT.note(
+                "async_round", task=task.id, quorum=quorum,
+                selected=selected, accepted=accepted_stations,
+                killed=sorted(killed), round_s=time.monotonic() - t0,
+                deadline_s=spec.deadline_s,
+            )
+        except Exception:  # pragma: no cover
+            pass
+        return {
+            "task": task,
+            "selected": selected,
+            "accepted": accepted_stations,
+            "killed": sorted(killed),
+            "accept_mask": accept_mask,
+            "staleness": staleness,
+            "quorum": quorum,
+            "round_s": time.monotonic() - t0,
+        }
+
     # ------------------------------------------------------------- wait loop
     def _runs_in_flight(self, runs: list[Run]) -> list[Run]:
         with self._inflight_lock:
@@ -545,6 +781,15 @@ class Federation:
                 )
             elif not self._online[run.station_index]:
                 run.status = TaskStatus.PENDING  # queued until reconnect
+            elif self._admission_limited and not getattr(
+                fn, "__v6t_device_step__", False
+            ):
+                # autopilot admission control (queue_buildup): host runs
+                # queue PENDING instead of dispatching; lifting the limit
+                # drains them (set_admission_limited). Device-mode programs
+                # are exempt — they never transit the executor backlog the
+                # alert is about.
+                run.status = TaskStatus.PENDING
             else:
                 runnable.append(run)
         if not runnable or fn is None:
@@ -679,9 +924,18 @@ class Federation:
         self, task: Task, fn: Callable, run: Run, trace_parent: Any = None,
     ) -> None:
         from vantage6_tpu.algorithm.client import AlgorithmClient
+        from vantage6_tpu.common.faults import FAULTS
 
         if not run.start():
             return  # killed between queue-pop and start
+        # fault-injection points (common.faults, V6T_FAULTS=): a delayed
+        # station models slow hardware/data skew (straggler food group); a
+        # dropped result leaves the run wedged ACTIVE — the stuck_run
+        # watchdog rule's food, and what a crashed daemon looks like from
+        # the server's side
+        FAULTS.sleep_station_delay(run.station_index)
+        if FAULTS.drop_result(run.station_index):
+            return
         try:
             frames = [
                 self._resolve_frame(task, run.station_index, d)
@@ -947,12 +1201,15 @@ class Federation:
         return decompress_wire_tree(payload)
 
     # ------------------------------------------------------ elastic recovery
-    def _drain_pending(self, station: int) -> None:
+    def _drain_pending(self, station: int, wait: bool = True) -> None:
         """Reference parity: a reconnecting node syncs its missed task queue
         (`sync_task_queue_with_server`) and executes what it owes. Host runs
         drain through the executor pool (per-station FIFO keeps them ordered
         after anything already queued); the call blocks until the owed runs
-        finished, so `set_station_online` keeps its synchronous contract."""
+        finished, so `set_station_online` keeps its synchronous contract.
+        ``wait=False`` submits without blocking — the admission-control
+        revert path, which runs on the watchdog's listener thread and must
+        not stall evaluation behind the very backlog it is draining."""
         owed: list[Run] = []
         with self._inflight_lock:
             already = set(self._inflight_runs)
@@ -975,7 +1232,7 @@ class Federation:
                     else:
                         self._submit_host_run(task, fn, run)
                         owed.append(run)
-        if owed:
+        if owed and wait:
             self._await_inflight(owed)
 
     # --------------------------------------------------------- observability
@@ -1036,6 +1293,26 @@ class Federation:
                         "started_at": run.started_at,
                         "organization_id": run.station_index,
                     })
+        # WEDGED runs: ACTIVE but no longer queued/executing on the pool —
+        # a worker returned without the run reaching a terminal state (a
+        # dropped result, fault-injected or real). Exactly the stuck_run
+        # rule's food, and invisible to the inflight scan above.
+        seen_ids = {r["run_id"] for r in runs}
+        for task in tasks[-self.config.n_stations * 8:]:
+            for run in task.runs:
+                if (
+                    run.status == TaskStatus.ACTIVE
+                    and run.id not in inflight
+                    and run.id not in seen_ids
+                ):
+                    runs.append({
+                        "run_id": run.id,
+                        "task_id": task.id,
+                        "status": "active",
+                        "assigned_at": run.assigned_at,
+                        "started_at": run.started_at,
+                        "organization_id": run.station_index,
+                    })
         for task in tasks[-self.config.n_stations * 8:]:
             if len(task.runs) < 2 or not task.is_finished:
                 continue
@@ -1059,6 +1336,16 @@ class Federation:
         state: dict[str, Any] = {"runs": runs, "rounds": rounds, "now": now}
         if executor is not None:
             state["executor"] = executor.stats()
+        # autopilot/async context for operators reading /api/alerts: which
+        # stations are currently masked or down-weighted, and the
+        # admission flag (scalar keys are ignored by feed_items — rules
+        # only consume the list-valued entries above)
+        state["stations_masked"] = [
+            i for i, m in enumerate(self._masked) if m
+        ]
+        state["selection_weights"] = list(self._selection_weights)
+        state["staleness"] = list(self._staleness)
+        state["admission_limited"] = self._admission_limited
         return state
 
     # -------------------------------------------------------------- teardown
@@ -1067,6 +1354,9 @@ class Federation:
         dropped). Idempotent; the Federation stays readable."""
         from vantage6_tpu.runtime.watchdog import WATCHDOG
 
+        if self.autopilot is not None:
+            self.autopilot.detach()
+            self.autopilot = None
         WATCHDOG.unregister_feed(self._watchdog_key, self._watchdog_feed_fn)
         if self._executor is not None:
             self._executor.close()

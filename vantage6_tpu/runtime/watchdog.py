@@ -611,7 +611,9 @@ def default_rules() -> list[AlertRule]:
             runbook=(
                 "restart the node daemon; its startup resync re-claims "
                 "pending runs. Runs it held past the deadline raise "
-                "stuck_run separately."
+                "stuck_run separately. Automated: the autopilot requeues "
+                "the node's ACTIVE runs (CAS-guarded, one-shot) — see "
+                "docs/OPERATOR_GUIDE.md 'autopilot'."
             ),
             metrics=(),
             check=_check_daemon_lapsed,
@@ -630,7 +632,10 @@ def default_rules() -> list[AlertRule]:
                 "remove the dead replica. Attribute its in-flight work "
                 "with trace_view (spans carry replica_id). Warning, not "
                 "critical: N-1 replicas is degraded capacity, not an "
-                "outage (see docs/control_plane.md)."
+                "outage (see docs/control_plane.md). Automated: the "
+                "autopilot requeues runs the dead replica's lost reports "
+                "stranded ACTIVE (CAS-guarded, one-shot) — see "
+                "docs/OPERATOR_GUIDE.md 'autopilot'."
             ),
             metrics=(),
             check=_check_replica_lapsed,
@@ -646,7 +651,10 @@ def default_rules() -> list[AlertRule]:
             runbook=(
                 "compare the station's exec spans (trace_view straggler "
                 "call-out) against its wire bytes; consider async "
-                "aggregation or re-balancing its shard."
+                "aggregation (run_buffered) or re-balancing its shard. "
+                "Automated: the autopilot shrinks the station's selection "
+                "weight while this alert is active and restores it on "
+                "clear — see docs/OPERATOR_GUIDE.md 'autopilot'."
             ),
             metrics=(),
             check=_check_straggler_station,
@@ -667,7 +675,9 @@ def default_rules() -> list[AlertRule]:
                 "table from a dump); inspect the station's data/labels, "
                 "then drop it from the next task's organizations or mask "
                 "it — the pooled update already nan-isolates zero-weight "
-                "stations."
+                "stations. Automated: the autopilot masks the station out "
+                "of the aggregate while this alert is active and unmasks "
+                "it on clear — see docs/OPERATOR_GUIDE.md 'autopilot'."
             ),
             metrics=(),
             check=_check_anomalous_station,
@@ -720,7 +730,10 @@ def default_rules() -> list[AlertRule]:
             runbook=(
                 "raise executor_workers, throttle task creation, or check "
                 "for a station whose FIFO is blocked by a long run "
-                "(queue_wait_s in run_lifecycle)."
+                "(queue_wait_s in run_lifecycle). Automated: the autopilot "
+                "applies admission control (new host runs queue instead of "
+                "dispatching) while this alert is active and drains on "
+                "clear — see docs/OPERATOR_GUIDE.md 'autopilot'."
             ),
             metrics=(
                 "v6t_executor_inflight_items",
@@ -894,6 +907,7 @@ class Watchdog:
         self._active: dict[Any, Alert] = {}  # guarded-by: _lock
         self._recent: deque[Alert] = deque(maxlen=256)  # guarded-by: _lock
         self._feed_error_keys: set[str] = set()  # guarded-by: _lock
+        self._listeners: dict[str, Callable[[str, Alert], Any]] = {}  # guarded-by: _lock
         self.last_eval_at: float | None = None
         self._users = 0  # guarded-by: _lock (refcounted start/stop)
         self._thread: threading.Thread | None = None
@@ -940,6 +954,26 @@ class Watchdog:
     def has_feed(self, key: str) -> bool:
         with self._lock:
             return key in self._feeds
+
+    def add_listener(self, key: str, fn: Callable[[str, Alert], Any]) -> None:
+        """Register (or replace — same key) a transition listener:
+        ``fn(event, alert)`` with event ``"raised"`` or ``"cleared"``,
+        called synchronously after the transition's own emits (span, log,
+        flight note) so anything the listener does — the autopilot's
+        remediation spans in particular — nests correctly after the
+        alert's. Listeners are fail-soft: one raising never blocks the
+        others or the evaluation."""
+        with self._lock:
+            self._listeners[key] = fn
+
+    def remove_listener(
+        self, key: str, fn: Callable[[str, Alert], Any] | None = None
+    ) -> None:
+        """Remove a listener; with ``fn``, only if it is still the
+        registered one (same contract as unregister_feed)."""
+        with self._lock:
+            if fn is None or self._listeners.get(key) == fn:
+                self._listeners.pop(key, None)
 
     def register_component(self, name: str, fn: Callable[[], Any]) -> None:
         """Register a health self-check: ``fn()`` returns ``(ok, detail)``
@@ -1068,8 +1102,10 @@ class Watchdog:
 
         for alert in raised:
             self._emit_raise(alert)
+            self._notify_listeners("raised", alert)
         for alert in cleared:
             self._emit_clear(alert)
+            self._notify_listeners("cleared", alert)
 
         REGISTRY.counter("v6t_watchdog_evaluations_total").inc()
         if raised:
@@ -1099,6 +1135,7 @@ class Watchdog:
         attrs = {
             "severity": alert.severity,
             "message": alert.message,
+            "transition": "raised",
             **{f"label_{k}": v for k, v in alert.labels.items()},
         }
         # the span is ACTIVE around the warning log so the log record is
@@ -1129,20 +1166,52 @@ class Watchdog:
             pass
 
     def _emit_clear(self, alert: Alert) -> None:
-        log.info(
-            "alert cleared [%s/%s] after %.1fs: %s", alert.severity,
-            alert.rule, (alert.resolved_at or 0) - alert.raised_at,
-            alert.message,
-        )
+        # symmetric with _emit_raise: the clear gets its own span on the
+        # SAME trace (alert.traceparent), so a remediation revert — which
+        # the autopilot hangs off this transition — is as visible in
+        # doctor timelines as the raise that triggered the action
+        duration_s = (alert.resolved_at or 0) - alert.raised_at
+        attrs = {
+            "severity": alert.severity,
+            "message": alert.message,
+            "transition": "cleared",
+            "duration_s": duration_s,
+            **{f"label_{k}": v for k, v in alert.labels.items()},
+        }
+        with TRACER.span(
+            f"alert.{alert.rule}", kind="alert", service="watchdog",
+            parent=alert.traceparent,  # None -> fresh root trace
+            attrs=attrs,
+        ) as sp:
+            sp.add_event("alert_cleared", rule=alert.rule,
+                         severity=alert.severity)
+            log.info(
+                "alert cleared [%s/%s] after %.1fs: %s", alert.severity,
+                alert.rule, duration_s, alert.message,
+            )
         try:
             from vantage6_tpu.common.flight import FLIGHT
 
             FLIGHT.note(
                 "alert_cleared", rule=alert.rule, severity=alert.severity,
-                labels=alert.labels,
+                message=alert.message, labels=alert.labels,
+                traceparent=alert.traceparent, duration_s=duration_s,
             )
         except Exception:  # pragma: no cover
             pass
+
+    def _notify_listeners(self, event: str, alert: Alert) -> None:
+        with self._lock:
+            listeners = list(self._listeners.items())
+        for key, fn in listeners:
+            try:
+                fn(event, alert)
+            except Exception as e:
+                REGISTRY.counter("v6t_watchdog_feed_errors_total").inc()
+                log.warning(
+                    "watchdog listener %s failed on %s %s: %s",
+                    key, alert.rule, event, e,
+                )
 
     # -------------------------------------------------------------- queries
     def active_alerts(self) -> list[dict[str, Any]]:

@@ -117,6 +117,23 @@ class ServerApp:
         WATCHDOG.register_component("event_hub", self._hub_check)
         WATCHDOG.register_component("tracer_sink", _tracer_sink_check)
         WATCHDOG.start()
+        # autopilot remediation over the store (runtime.autopilot,
+        # docs/OPERATOR_GUIDE.md "autopilot"): opt-in via V6T_AUTOPILOT.
+        # The server actuator only carries the requeue capabilities —
+        # selection/mask/admission policies self-suppress here. Listener
+        # key is per-replica: two replicas may both attach, and the
+        # store-level CAS keeps their concurrent remediation exactly-once.
+        self.autopilot = None
+        if os.environ.get("V6T_AUTOPILOT", "").strip().lower() in (
+            "1", "true", "yes", "on",
+        ):
+            from vantage6_tpu.runtime.autopilot import Autopilot
+
+            self.autopilot = Autopilot(
+                actuator=ServerActuator(self),
+                listener_key=f"autopilot-{self.replica_id}",
+            )
+            self.autopilot.attach()
         register_resources(self)
         from vantage6_tpu.server.ui import register_ui
 
@@ -251,6 +268,9 @@ class ServerApp:
         from vantage6_tpu.common.telemetry import REGISTRY
         from vantage6_tpu.runtime.watchdog import WATCHDOG
 
+        if self.autopilot is not None:
+            self.autopilot.detach()
+            self.autopilot = None
         # only if still ours: a newer ServerApp may have replaced the feed
         # (keyed registration — same story as the telemetry collector);
         # the shared components go only when no server feed remains at all
@@ -330,6 +350,101 @@ class ServerApp:
             return server.start_background()
         server.serve_forever()
         return server
+
+
+class ServerActuator:
+    """Autopilot capabilities over the server's store (duck-typed by
+    runtime.autopilot): re-queue runs orphaned by a lapsed daemon or a
+    lapsed replica. Selection-weight / mask / admission capabilities are
+    Federation-side — policies needing them self-suppress here.
+
+    Both requeues are CAS-guarded (`TaskRun.compare_and_swap` with the
+    observed status as the expectation), the same idiom as claim-batch's
+    orphan reset: two replicas' autopilots remediating the SAME
+    daemon_lapsed alert concurrently re-queue each orphan exactly once —
+    the loser's swap fails and it leaves the run alone.
+    """
+
+    def __init__(self, srv: ServerApp):
+        self.srv = srv
+
+    def _requeue(
+        self, run: "models.TaskRun", status: Any, message: str
+    ) -> bool:
+        from vantage6_tpu.common.enums import TaskStatus
+        from vantage6_tpu.server import events as ev
+
+        if not models.TaskRun.compare_and_swap(
+            run.id,
+            sets={"status": TaskStatus.PENDING.value, "log": message},
+            expect={"status": status.value},
+        ):
+            return False
+        task = models.Task.get(run.task_id)
+        if task is not None:
+            self.srv.hub.emit(
+                ev.STATUS_UPDATE,
+                {
+                    "task_id": task.id,
+                    "run_id": run.id,
+                    "status": TaskStatus.PENDING.value,
+                    "organization_id": run.organization_id,
+                    "task_status": task.status(),
+                },
+                room=ev.collaboration_room(task.collaboration_id),
+            )
+        return True
+
+    def requeue_node_runs(self, node_id: int) -> int:
+        """daemon_lapsed remediation: the node stopped pinging mid-run,
+        so its INITIALIZING/ACTIVE runs will never report — put them back
+        to PENDING for whoever claims next (the restarted daemon, or a
+        peer node of the same organization). Returns how many runs THIS
+        caller re-queued (a concurrent peer's CAS wins count there)."""
+        from vantage6_tpu.common.enums import TaskStatus
+
+        node = models.Node.get(node_id)
+        if node is None:
+            return 0
+        requeued = 0
+        for status in (TaskStatus.INITIALIZING, TaskStatus.ACTIVE):
+            for run in models.TaskRun.list(
+                status=status.value, organization_id=node.organization_id
+            ):
+                if run.node_id is not None and run.node_id != node_id:
+                    continue  # a sibling node's live work
+                if self._requeue(
+                    run, status,
+                    "daemon lapsed mid-run; re-queued by autopilot",
+                ):
+                    requeued += 1
+        return requeued
+
+    def requeue_replica_runs(self, replica_id: str) -> int:
+        """replica_lapsed remediation: a peer replica died; any run whose
+        node has meanwhile gone offline has lost both its server AND its
+        executor — re-queue those. Runs of still-online nodes are left
+        alone (any surviving replica serves their reports)."""
+        from vantage6_tpu.common.enums import TaskStatus
+
+        requeued = 0
+        node_status: dict[int | None, str] = {None: "offline"}
+        for status in (TaskStatus.INITIALIZING, TaskStatus.ACTIVE):
+            for run in models.TaskRun.list(status=status.value):
+                if run.node_id not in node_status:
+                    node = models.Node.get(run.node_id)
+                    node_status[run.node_id] = (
+                        (node.status or "offline") if node else "offline"
+                    )
+                if node_status[run.node_id] == "online":
+                    continue
+                if self._requeue(
+                    run, status,
+                    f"replica {replica_id} lapsed with the node offline; "
+                    "re-queued by autopilot",
+                ):
+                    requeued += 1
+        return requeued
 
 
 def _tracer_sink_check() -> tuple[bool, str]:
