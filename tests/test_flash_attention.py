@@ -181,3 +181,70 @@ class TestRecomputeAttention:
             jnp.ones(2),
         )
         assert np.isfinite(float(loss))
+
+
+class TestInterpreterTwin:
+    """`interpreter_twin` is the kernel's bit-exactness oracle: a pure-jnp
+    transliteration of the Pallas grid (same op sequence, same block
+    sweep), so interpret-mode flash must match it to the BIT — not within
+    a tolerance. A tolerance here would hide an accidental reassociation
+    in the kernel (the exact class of bug that later diverges on real TPU
+    MXU/VPU paths where op order matters most)."""
+
+    @pytest.mark.parametrize("t", [128, 1024])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bit_exact_vs_interpret_kernel(self, t, causal):
+        from vantage6_tpu.ops.flash_attention import interpreter_twin
+
+        b, h, d = 1, 2, 16
+        q, k, v = (rand((b, h, t, d), s) for s in (30, 31, 32))
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        twin = interpreter_twin(q, k, v, causal=causal)
+        assert out.dtype == twin.dtype
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(twin))
+
+    def test_bit_exact_with_padding_and_offsets(self):
+        """t=100 forces the ragged tail (block padding + kvalid mask);
+        offsets exercise the ring-hop position arithmetic."""
+        from vantage6_tpu.ops.flash_attention import interpreter_twin
+
+        b, h, t, d = 2, 2, 100, 8
+        q, k, v = (rand((b, h, t, d), s) for s in (33, 34, 35))
+        out = flash_attention(
+            q, k, v, q_offset=4, k_offset=0, causal=True,
+            block_q=32, block_k=32, interpret=True,
+        )
+        twin = interpreter_twin(
+            q, k, v, q_offset=4, k_offset=0, causal=True,
+            block_q=32, block_k=32,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(twin))
+
+    def test_bit_exact_bf16(self):
+        from vantage6_tpu.ops.flash_attention import interpreter_twin
+
+        b, h, t, d = 1, 2, 128, 16
+        q, k, v = (
+            rand((b, h, t, d), s).astype(jnp.bfloat16) for s in (36, 37, 38)
+        )
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        twin = interpreter_twin(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(out.astype(jnp.float32)),
+            np.asarray(twin.astype(jnp.float32)),
+        )
+
+    def test_twin_itself_matches_reference(self):
+        """The oracle is anchored: the twin stays allclose to the naive
+        softmax reference, so a kernel+twin agreeing on WRONG math can't
+        pass silently."""
+        from vantage6_tpu.ops.flash_attention import interpreter_twin
+
+        b, h, t, d = 2, 2, 128, 16
+        q, k, v = (rand((b, h, t, d), s) for s in (39, 40, 41))
+        twin = interpreter_twin(q, k, v, causal=True)
+        ref = reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(twin), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )

@@ -457,6 +457,38 @@ class TestTracerHygiene:
         found = [x for x in result.unwaived if x.rule == "tracer-impure-call"]
         assert {f.context for f in found} == {"bad#time.time", "bad#random.random"}
 
+    def test_scan_body_through_partial(self, tmp_path):
+        """lax.scan(functools.partial(body, cfg), ...) — the fused-rounds
+        idiom (a scan body with bound config): the closure walk must
+        unwrap the partial and descend into the BODY, catching impure
+        calls there; the well-behaved twin stays clean."""
+        result = run_fixture(tmp_path, {"m.py": """
+            import functools
+            import time
+
+            import jax
+            from jax import lax
+
+            def body_bad(cfg, carry, x):
+                t = time.time()  # impure under trace: one firing per round
+                return carry + x * cfg + t, None
+
+            def body_good(cfg, carry, x):
+                return carry + x * cfg, None
+
+            @jax.jit
+            def bad(xs):
+                out, _ = lax.scan(functools.partial(body_bad, 2.0), 0.0, xs)
+                return out
+
+            @jax.jit
+            def good(xs):
+                out, _ = lax.scan(functools.partial(body_good, 2.0), 0.0, xs)
+                return out
+            """})
+        found = [x for x in result.unwaived if x.rule == "tracer-impure-call"]
+        assert {f.context for f in found} == {"body_bad#time.time"}
+
     def test_pure_callback_exempts_host_escape(self, tmp_path):
         result = run_fixture(tmp_path, {"m.py": """
             import jax

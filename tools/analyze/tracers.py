@@ -165,6 +165,17 @@ class TracerPass:
         return [self.index.functions[q] for q in sorted(seen) if q in self.index.functions]
 
     def _resolve_ref(self, fi: FuncInfo, expr: ast.AST) -> FuncInfo | None:
+        # functools.partial(body, cfg) handed to a wrapper (a lax.scan
+        # body with bound config, a pallas_call kernel with static
+        # kwargs): the traced callable is partial's FIRST argument —
+        # unwrap (nested partials too) so the closure walk descends into
+        # the body instead of stopping at the opaque Call node
+        while (
+            isinstance(expr, ast.Call)
+            and expr.args
+            and (dotted(expr.func) or "").rsplit(".", 1)[-1] == "partial"
+        ):
+            expr = expr.args[0]
         fake = ast.Call(func=expr, args=[], keywords=[])
         target = self.index.resolve_call(fi, fake)
         return target if isinstance(target, FuncInfo) else None

@@ -82,6 +82,13 @@ HEADLINES: list[tuple[str, str, str]] = [
     # label-flip-poisoned station hands-off
     ("straggler_resilience_pct", "higher", "autopilot"),
     ("autopilot_mask_detect_s", "lower", "autopilot"),
+    # fused multi-round device program (lax.scan over whole rounds, one
+    # dispatch per K rounds): round throughput of the single fused
+    # executable, and the fraction of v5e bf16 peak it achieves on-chip.
+    # The MFU row is TPU-only (main() leaves it null on CPU fallback
+    # rounds, where FLOPs/peak is not meaningful), so CPU rounds show "—".
+    ("fused_rounds_per_sec", "higher", "fused"),
+    ("fused_mfu_vs_v5e_bf16_peak", "higher", "fused"),
 ]
 
 _NUM_RE = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
@@ -100,12 +107,22 @@ def _flatten(obj: Any, out: dict[str, float], depth: int = 0) -> None:
 
 def extract_round(path: str) -> dict[str, Any] | None:
     """One round's usable view: {round, platform, invalid, values{}}."""
-    try:
-        doc = json.load(open(path))
-    except (OSError, json.JSONDecodeError):
-        return None
     m = re.search(r"r(\d+)", os.path.basename(path))
     rnd = int(m.group(1)) if m else -1
+    try:
+        doc = json.load(open(path))
+    except (OSError, json.JSONDecodeError) as e:
+        # still surfaced: a corrupt round file should read as "this round
+        # is broken", not as a silent gap in the trend table
+        return {
+            "round": rnd,
+            "file": os.path.basename(path),
+            "platform": "unknown",
+            "invalid": True,
+            "rc": None,
+            "values": {},
+            "note": f"invalid round: unreadable JSON ({type(e).__name__})",
+        }
     values: dict[str, float] = {}
     platform = None
     parsed = doc.get("parsed")
@@ -126,16 +143,28 @@ def extract_round(path: str) -> dict[str, Any] | None:
         if platform is None:
             pm = re.search(r'"platform"\s*:\s*"(\w+)"', tail)
             platform = pm.group(1) if pm else None
+    note = None
     if not values:
-        return None
-    return {
+        # `parsed: null` (driver never recovered a JSON tail) or a tail
+        # with no headline hits: keep the round VISIBLE as an explicit
+        # invalid-round column instead of silently dropping it — a wedged
+        # bench run should read as a hole in the trend, not a shorter one
+        note = (
+            "invalid round: parsed is null and no headline values in tail"
+            if not isinstance(parsed, dict)
+            else "invalid round: no headline values in parsed output"
+        )
+    row = {
         "round": rnd,
         "file": os.path.basename(path),
         "platform": platform or "unknown",
-        "invalid": bool(doc.get("invalid")),
+        "invalid": bool(doc.get("invalid")) or not values,
         "rc": doc.get("rc"),
         "values": values,
     }
+    if note:
+        row["note"] = note
+    return row
 
 
 def collect(root: str) -> list[dict[str, Any]]:
@@ -240,6 +269,11 @@ def main(argv: list[str]) -> int:
         ))
     else:
         print(render_table(rounds))
+        noted = [r for r in rounds if r.get("note")]
+        if noted:
+            print("\ninvalid rounds (shown above, excluded from baselines):")
+            for r in noted:
+                print(f"  {r['file']}: {r['note']}")
         if regs:
             print("\nREGRESSIONS (latest vs best prior, same platform):")
             for r in regs:

@@ -253,6 +253,66 @@ def check_device_observatory() -> list[str]:
     return problems
 
 
+def check_fused_program() -> list[str]:
+    """Audit the fused multi-round program's telemetry surface
+    (fed/fedavg.py ``_record_fused``, docs/device_speed.md):
+
+    - every ``v6t_fused_*`` metric declared in KNOWN_METRICS is actually
+      emitted by fed/fedavg.py (string literal there) — a declared-but-
+      never-emitted series is documentation lying about the scrape;
+    - every ``v6t_fused_*`` literal fedavg.py emits is declared — an
+      undeclared series renders untyped and escapes this audit forever;
+    - docs/device_speed.md (the fused-program design note) exists and is
+      linked from the README, so the K-selection guidance stays findable.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import KNOWN_METRICS
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import telemetry registry: {e!r}"]
+    path = os.path.join(_REPO_ROOT, "vantage6_tpu", "fed", "fedavg.py")
+    try:
+        source = open(path).read()
+    except OSError as e:
+        return [f"cannot read fed/fedavg.py: {e}"]
+    declared = {
+        name for name, _kind, _help in KNOWN_METRICS
+        if name.startswith("v6t_fused_")
+    }
+    if not declared:
+        problems.append(
+            "no v6t_fused_* metrics declared in KNOWN_METRICS — the fused "
+            "program's dispatch amortization is unobservable"
+        )
+    emitted = set(re.findall(r'"(v6t_fused_[a-z0-9_]*)"', source))
+    for name in sorted(declared - emitted):
+        problems.append(
+            f"metric {name!r} declared in KNOWN_METRICS but never emitted "
+            "by fed/fedavg.py"
+        )
+    for name in sorted(emitted - declared):
+        problems.append(
+            f"fed/fedavg.py emits {name!r} which is not declared in "
+            "KNOWN_METRICS (common/telemetry.py)"
+        )
+    doc = os.path.join(_REPO_ROOT, "docs", "device_speed.md")
+    if not os.path.exists(doc):
+        problems.append("docs/device_speed.md missing (fused-program "
+                        "design + K-selection guidance)")
+    try:
+        readme = open(os.path.join(_REPO_ROOT, "README.md")).read()
+    except OSError:
+        readme = ""
+    if "docs/device_speed.md" not in readme:
+        problems.append(
+            "README.md does not link docs/device_speed.md — the fused "
+            "fast path's usage guidance is unreachable from the front door"
+        )
+    return problems
+
+
 def check_learning_plane() -> list[str]:
     """Audit the learning-plane surface (runtime/learning.py,
     docs/observability.md "learning plane"):
@@ -737,6 +797,17 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    fused_problems = check_fused_program()
+    if fused_problems:
+        sys.stderr.write(
+            "FUSED PROGRAM DRIFT: the declared v6t_fused_* surface, "
+            "fed/fedavg.py, or the docs/device_speed.md link drifted "
+            "(docs/device_speed.md):\n"
+        )
+        for p in fused_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     learning_problems = check_learning_plane()
     if learning_problems:
         sys.stderr.write(
@@ -831,6 +902,8 @@ def main(argv: list[str]) -> int:
               "declared <-> emitted, profile route audited")
         print("learning-plane audit ok: v6t_round_*/v6t_station_* declared "
               "<-> emitted, rules cataloged, rounds route audited")
+        print("fused-program audit ok: v6t_fused_* declared <-> emitted, "
+              "docs/device_speed.md present and linked")
         print("storage-backend audit ok: sqlite3 contained to db.py, "
               "BACKENDS coherent, invalidation bus emit <-> apply agree")
         print("static analysis ok: v6lint found no unwaived violations")

@@ -188,6 +188,10 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
     ("v6t_jit_retraces_total", "counter",
      "retraces: an observed function compiled against a NEW abstract "
      "signature (recompile_storm's series)"),
+    ("v6t_jit_static_sweeps_total", "counter",
+     "compiles differing from a seen signature only in declared sweep "
+     "statics (the fused program's n_rounds) — planned executables, "
+     "excluded from the retrace series"),
     ("v6t_jit_fallbacks_total", "counter",
      "observed dispatches that fell back to plain jax.jit (tracer args, "
      "sharding mismatch, AOT-unloweable call)"),
@@ -210,6 +214,16 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
      "mesh.fingerprint()-keyed runner cache misses (fresh compiles)"),
     ("v6t_engine_cache_entries", "gauge",
      "live entries across the fingerprint-keyed runner caches"),
+    # fused multi-round device program (fed.fedavg.run_rounds /
+    # run_rounds_async — docs/device_speed.md): how many logical rounds
+    # each host dispatch amortizes
+    ("v6t_fused_dispatches_total", "counter",
+     "fused K-round program dispatches (one per run_rounds call)"),
+    ("v6t_fused_rounds_total", "counter",
+     "logical federated rounds executed inside fused dispatches"),
+    ("v6t_fused_rounds_per_dispatch", "gauge",
+     "K of the most recent fused dispatch (rounds amortized per host "
+     "round-trip)"),
     # per-device memory (runtime.profiling device_mem collector; absent
     # on backends reporting no memory stats, e.g. CPU)
     ("v6t_device_count", "gauge",

@@ -300,6 +300,31 @@ def station_update_stats(
     return out
 
 
+def per_round_masks(mask: Any, n_rounds: int) -> jax.Array:
+    """Participation masks for a fused K-round program as a ``[K, S]``
+    f32 matrix — the scan-xs form of the participation seam.
+
+    Accepts a ``[S]`` mask (one roster for every round — broadcast, the
+    common case) or an already per-round ``[K, S]`` matrix (buffered-async
+    accept masks, per-round fault schedules). Rank is static under
+    tracing, so both forms flow through the SAME fused executable without
+    retracing; a wrong leading length on the ``[K, S]`` form is a
+    host-side error, not a silent truncation.
+    """
+    m = jnp.asarray(mask, jnp.float32)
+    if m.ndim == 1:
+        return jnp.broadcast_to(m, (n_rounds,) + m.shape)
+    if m.ndim != 2:
+        raise ValueError(
+            f"mask must be [S] or [n_rounds, S], got rank {m.ndim}"
+        )
+    if m.shape[0] != n_rounds:
+        raise ValueError(
+            f"per-round mask has {m.shape[0]} rounds, expected {n_rounds}"
+        )
+    return m
+
+
 def _local_weighted_flat_sum(
     local_stacked: Pytree, local_w: jax.Array
 ) -> jax.Array:

@@ -312,6 +312,32 @@ def record_round_telemetry(
     _record_compress_telemetry(spec, n, count=n_stations * rounds)
 
 
+def fused_wire_plan(
+    spec: CompressorSpec | None, n: int, n_stations: int, n_rounds: int
+) -> dict[str, Any]:
+    """Static wire accounting for one FUSED K-round dispatch
+    (docs/device_speed.md): total raw vs on-wire delta-uplink bytes over
+    all ``n_rounds`` fused rounds, plus the per-dispatch host-transfer
+    saving the fusion buys — ``host_pulls`` collapses from ``n_rounds``
+    (one losses/stats pull per sequential round) to 1. Metadata-only;
+    ``spec=None`` (or an identity compressor) accounts the dense case.
+    The bench's fused leg and K-selection guidance read exactly this."""
+    wire_each = (
+        4 * n if spec is None or spec.identity else spec.wire_nbytes(n)
+    )
+    raw = 4 * n * n_stations * n_rounds
+    wire = wire_each * n_stations * n_rounds
+    return {
+        "n_params": n,
+        "n_rounds": n_rounds,
+        "raw_bytes": raw,
+        "wire_bytes": wire,
+        "reduction": round(4.0 * n / max(1, wire_each), 2),
+        "host_pulls": 1,
+        "host_pulls_sequential": n_rounds,
+    }
+
+
 def compress_delta(
     spec: CompressorSpec,
     flat: Any,

@@ -36,10 +36,12 @@ from vantage6_tpu.fed.collectives import (
     flatten_stacked,
     flatten_tree,
     padded_flat_size,
+    per_round_masks,
     station_update_stats,
     unflatten_like,
     unflatten_stacked,
 )
+from vantage6_tpu.common.telemetry import REGISTRY
 from vantage6_tpu.fed.compression import (
     CompressorSpec,
     compress_stacked,
@@ -86,6 +88,14 @@ class FedAvgSpec:
     # the replicated and scattered update paths. Off = stats come back as
     # an empty dict and the round pays nothing for them.
     learning_stats: bool = True
+    # Unroll factor of the inner local-steps lax.scan (True = fully
+    # unrolled, no while loop). Semantics and RNG streams are identical at
+    # any value — a pure compilation-strategy knob. XLA:CPU runs
+    # convolutions inside while-loop bodies ~6x slower than in straight-
+    # line code (measured, docs/device_speed.md), so CPU callers of the
+    # fused path want True; on TPU the scan form compiles faster and runs
+    # at the same speed, so the default stays 1.
+    local_unroll: int | bool = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,9 +174,15 @@ class FedAvg:
         # span + v6t_jit_* telemetry, and a shape-wobbling caller shows up
         # as a named retrace instead of silent slow rounds.
         self._round = observed_jit("fedavg.round", self._round_impl)
+        # n_rounds is a SWEEP static: callers legitimately compile the
+        # fused program at several K values (warmup K=1, production K=32,
+        # a tail-flush K=7). The observatory counts those as
+        # static_sweeps, not retraces — a K sweep must not trip
+        # recompile_storm (docs/device_speed.md "K-selection").
         self._run = observed_jit(
             "fedavg.run_rounds", self._run_impl,
-            static_argnames=("n_rounds",),
+            static_argnames=("n_rounds", "unroll"),
+            sweep_statics=("n_rounds", "unroll"),
         )
         # run_rounds IS the multi-round fast path: donating params,
         # opt_state and the key lets XLA update the scan carry in place
@@ -176,8 +192,23 @@ class FedAvg:
         # buffers.
         self._run_donating = observed_jit(
             "fedavg.run_rounds_donating", self._run_impl,
-            static_argnames=("n_rounds",),
+            static_argnames=("n_rounds", "unroll"),
+            sweep_statics=("n_rounds", "unroll"),
             donate_argnums=(0, 1, 6),  # params, opt_state, key
+        )
+        # fused buffered-async runner: staleness rides the scan carry so K
+        # async rounds (accept masks + FedBuff discounting) are one
+        # dispatch, composing with compression EF exactly like _run_impl.
+        self._run_async = observed_jit(
+            "fedavg.run_rounds_async", self._run_async_impl,
+            static_argnames=("n_rounds",),
+            sweep_statics=("n_rounds",),
+        )
+        self._run_async_donating = observed_jit(
+            "fedavg.run_rounds_async_donating", self._run_async_impl,
+            static_argnames=("n_rounds",),
+            sweep_statics=("n_rounds",),
+            donate_argnums=(0, 1, 6, 8),  # params, opt_state, key, staleness
         )
 
     # ------------------------------------------------------------ local step
@@ -209,7 +240,22 @@ class FedAvg:
             return p, loss
 
         step_keys = jax.random.split(key, spec.local_steps)
-        new_params, losses = jax.lax.scan(sgd_step, params, step_keys)
+        if spec.local_unroll is True:
+            # Python-unrolled: identical math over the identical key
+            # stream, but NO scan/while op in the lowered program —
+            # XLA:CPU executes the conv inside a scan body (even a fully
+            # `unroll=`-ed one, which keeps a trip-count-1 while) ~6x
+            # slower than the same conv in straight-line code (measured,
+            # docs/device_speed.md "K-selection").
+            new_params, step_losses = params, []
+            for i in range(spec.local_steps):
+                new_params, loss = sgd_step(new_params, step_keys[i])
+                step_losses.append(loss)
+            losses = jnp.stack(step_losses)
+        else:
+            new_params, losses = jax.lax.scan(
+                sgd_step, params, step_keys, unroll=spec.local_unroll
+            )
         delta = jax.tree.map(lambda n, o: n - o, new_params, params)
         return delta, jnp.mean(losses)
 
@@ -405,7 +451,7 @@ class FedAvg:
         out = self._round(
             params, opt_state, stacked_x, stacked_y, counts, mask, key
         )
-        self._record_history(out[2], out[3])
+        self._record_history(out[2], out[3], rounds_per_dispatch=1)
         return out
 
     def async_round(
@@ -484,12 +530,17 @@ class FedAvg:
         mask: jax.Array | None = None,
         opt_state: Any = None,
         donate: bool = True,
+        unroll: int | bool = 1,
     ):
         """`n_rounds` federated rounds as ONE compiled program (lax.scan) —
-        the benchmark fast path. Returns (params, opt_state, losses[n],
-        stats) — ``stats`` holds the per-round learning-plane arrays
-        stacked over the scan axis (``station_norm``/``station_cos``
-        ``[n, S]``, ``update_norm`` ``[n]``; {} when
+        the FUSED fast path (docs/device_speed.md): per-station training,
+        aggregation, compression EF and learning stats all stay on device
+        with zero host round-trips between rounds. ``mask`` may be ``[S]``
+        (one roster for the whole dispatch) or ``[n_rounds, S]`` (a
+        per-round roster riding the scan xs). Returns (params, opt_state,
+        losses[n], stats) — ``stats`` holds the per-round learning-plane
+        arrays stacked over the scan axis (``station_norm``/
+        ``station_cos`` ``[n, S]``, ``update_norm`` ``[n]``; {} when
         ``spec.learning_stats`` is off).
 
         Pass the ``opt_state`` from a checkpoint to CONTINUE a run (resuming
@@ -503,19 +554,79 @@ class FedAvg:
         Pass ``donate=False`` to keep the inputs alive (e.g. ablations
         re-running several configs from one init). ``round()`` never
         donates (tests/test_scattered_update.py pins both contracts).
+
+        ``unroll`` is the round-loop unroll factor (True = fully unrolled,
+        no while loop) — a pure compilation-strategy knob with identical
+        semantics at any value. Combine with ``FedAvgSpec.local_unroll``
+        on CPU, where XLA runs convolutions inside while-loop bodies ~6x
+        slower than straight-line (docs/device_speed.md "K-selection");
+        leave both at 1 on TPU, where the scan form compiles much faster
+        at the same execution speed.
         """
         if mask is None:
             mask = jnp.ones_like(counts)
         if opt_state is None:
             opt_state = self.init(params)
         self._record_wire(params, n_rounds=n_rounds)
+        self._record_fused(n_rounds)
         run = self._run_donating if donate else self._run
         out = run(
             params, opt_state, stacked_x, stacked_y, counts, mask, key,
-            n_rounds=n_rounds,
+            n_rounds=n_rounds, unroll=unroll,
         )
-        self._record_history(out[2], out[3])
+        self._record_history(out[2], out[3], rounds_per_dispatch=n_rounds)
         return out
+
+    def run_rounds_async(
+        self,
+        params: Pytree,
+        stacked_x: jax.Array,
+        stacked_y: jax.Array,
+        counts: jax.Array,
+        key: jax.Array,
+        n_rounds: int,
+        accept_masks: jax.Array,
+        spec: AsyncRoundSpec,
+        staleness: jax.Array | None = None,
+        mask: jax.Array | None = None,
+        opt_state: Any = None,
+        donate: bool = True,
+    ):
+        """``n_rounds`` buffered-async rounds as ONE fused program: the
+        FedBuff staleness vector rides the scan carry, so K rounds of
+        :meth:`async_round` semantics (accept-mask weighting discounted
+        by ``spec.staleness_discount ** staleness``) run with zero host
+        round-trips. ``accept_masks`` is ``[n_rounds, S]`` (each fused
+        round's first-K arrivals, e.g. from a quorum simulator) or ``[S]``
+        (same acceptance every round). Returns (params, opt_state,
+        staleness[S], losses[n], stats) — the final staleness vector
+        continues into the next fused dispatch, exactly like the host
+        bookkeeping it replaces."""
+        spec.validate()
+        if mask is None:
+            mask = jnp.ones_like(counts)
+        if staleness is None:
+            staleness = jnp.zeros_like(counts, dtype=jnp.float32)
+        if opt_state is None:
+            opt_state = self.init(params)
+        self._record_wire(params, n_rounds=n_rounds)
+        self._record_fused(n_rounds)
+        run = self._run_async_donating if donate else self._run_async
+        out = run(
+            params, opt_state, stacked_x, stacked_y, counts, mask, key,
+            accept_masks, jnp.asarray(staleness, jnp.float32),
+            jnp.float32(spec.staleness_discount), n_rounds=n_rounds,
+        )
+        self._record_history(out[3], out[4], rounds_per_dispatch=n_rounds)
+        return out
+
+    def _record_fused(self, n_rounds: int) -> None:
+        """Fused-program telemetry (host-side, metadata only): how many
+        logical rounds each dispatch amortizes — the `v6t_fused_*` series
+        docs/device_speed.md reads beside rounds_per_sec."""
+        REGISTRY.counter("v6t_fused_dispatches_total").inc()
+        REGISTRY.counter("v6t_fused_rounds_total").inc(n_rounds)
+        REGISTRY.gauge("v6t_fused_rounds_per_dispatch").set(n_rounds)
 
     # --------------------------------------------------------- learning plane
     def attach_history(self, history: Any) -> Any:
@@ -534,12 +645,16 @@ class FedAvg:
         self.history = history
         return history
 
-    def _record_history(self, losses: Any, stats: Any) -> None:
+    def _record_history(
+        self, losses: Any, stats: Any, rounds_per_dispatch: int = 1
+    ) -> None:
         history = getattr(self, "history", None)
         if history is None or not stats:
             return
         try:
-            history.record_engine(losses, stats)
+            history.record_engine(
+                losses, stats, rounds_per_dispatch=rounds_per_dispatch
+            )
         except Exception:  # observability must never fail the round
             import logging
 
@@ -549,18 +664,70 @@ class FedAvg:
 
     def _run_impl(
         self, params, opt_state, stacked_x, stacked_y, counts, mask, key,
-        *, n_rounds: int
+        *, n_rounds: int, unroll: int | bool = 1
     ):
+        # the participation mask rides the scan xs (one [S] row per
+        # round), not the closure: a [S] mask broadcasts to every round,
+        # a [K, S] matrix gives each fused round its own roster — same
+        # executable either way (rank is static), zero host round-trips
+        masks = per_round_masks(mask, n_rounds)
 
-        def body(carry, round_key):
+        def body(carry, xs):
+            round_key, m = xs
             p, s = carry
             p, s, loss, stats = self._round_impl(
-                p, s, stacked_x, stacked_y, counts, mask, round_key
+                p, s, stacked_x, stacked_y, counts, m, round_key
             )
             return (p, s), (loss, stats)
 
         keys = jax.random.split(key, n_rounds)
-        (params, opt_state), (losses, stats) = jax.lax.scan(
-            body, (params, opt_state), keys
-        )
+        if unroll is True:
+            # Python-unrolled round loop — same contract as the
+            # local_unroll fast path above: no while op survives in the
+            # lowered program, which is what lets XLA:CPU keep its fast
+            # conv path. Bit-identical to the scan form (same bodies over
+            # the same xs, in order).
+            carry, ys = (params, opt_state), []
+            for i in range(n_rounds):
+                carry, y = body(carry, (keys[i], masks[i]))
+                ys.append(y)
+            params, opt_state = carry
+            losses = jnp.stack([loss for loss, _ in ys])
+            stats = jax.tree.map(lambda *a: jnp.stack(a), *[s for _, s in ys])
+        else:
+            (params, opt_state), (losses, stats) = jax.lax.scan(
+                body, (params, opt_state), (keys, masks), unroll=unroll
+            )
         return params, opt_state, losses, stats
+
+    def _run_async_impl(
+        self, params, opt_state, stacked_x, stacked_y, counts, mask, key,
+        accept_masks, staleness, discount, *, n_rounds: int
+    ):
+        """K buffered-async rounds as ONE program: FedBuff staleness
+        (rounds since each station's last accepted update) rides the scan
+        CARRY, so the per-round effective mask ``accept * discount**stale
+        * mask`` — exactly :meth:`async_round`'s seam — is computed
+        on-device between fused rounds with no host in the loop."""
+        masks = per_round_masks(mask, n_rounds)
+        accepts = per_round_masks(accept_masks, n_rounds)
+        disc = jnp.asarray(discount, jnp.float32)
+
+        def body(carry, xs):
+            p, s, stale = carry
+            round_key, m, accept = xs
+            eff = accept * jnp.power(disc, stale) * m
+            p, s, loss, stats = self._round_impl(
+                p, s, stacked_x, stacked_y, counts, eff, round_key
+            )
+            # accepted stations reset; everyone else ages one round —
+            # the same bookkeeping Federation.run_buffered does host-side
+            stale = jnp.where(accept != 0, 0.0, stale + 1.0)
+            return (p, s, stale), (loss, stats)
+
+        keys = jax.random.split(key, n_rounds)
+        init = (params, opt_state, jnp.asarray(staleness, jnp.float32))
+        (params, opt_state, staleness), (losses, stats) = jax.lax.scan(
+            body, init, (keys, masks, accepts)
+        )
+        return params, opt_state, staleness, losses, stats

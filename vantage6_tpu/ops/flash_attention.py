@@ -310,6 +310,117 @@ def _flash_forward(
     return out[:, :, :t_q]
 
 
+def interpreter_twin(
+    q: jax.Array,  # [B, H, Tq, D]
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Pure-jnp re-execution of the Pallas kernel's EXACT op sequence —
+    the bit-exactness oracle for ``flash_attention(..., interpret=True)``.
+
+    Each grid cell of ``_flash_forward`` is replayed as a Python loop
+    over ``(batch*head, q-block)`` with the same padding, the same block
+    shapes, the same ``dot_general`` dimension numbers and f32
+    accumulation, the same iota/where masking and the same online-softmax
+    update order as ``_kernel`` — floating-point op-for-op, so the
+    comparison is ``==``, not allclose (tests/test_flash_attention.py
+    pins it at seq 128 and 1024). CAVEAT: bit-exact against the
+    INTERPRETED kernel (CPU, same XLA scalar ops); a real TPU run is
+    validated by the allclose oracle instead — MXU accumulation order is
+    hardware-defined and not reproducible op-for-op in jnp.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    scale = float(scale)
+    b, h, t_q, _ = q.shape
+    t_k = k.shape[2]
+    # identical padding/blocking decisions to _flash_forward
+    block_q = min(block_q, max(t_q, 8))
+    block_k = min(block_k, max(t_k, 8))
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_k) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    tq_p, tk_p = t_q + pad_q, t_k + pad_k
+    qh = q.reshape(b * h, tq_p, d)
+    kh = k.reshape(b * h, tk_p, d)
+    vh = v.reshape(b * h, tk_p, d)
+    qoff = jnp.asarray([q_offset], jnp.int32)
+    koff = jnp.asarray([k_offset], jnp.int32)
+    kvalid = jnp.asarray([t_k], jnp.int32)
+    out = jnp.zeros((b * h, tq_p, d), q.dtype)
+    for bh in range(b * h):
+        for qi in range(tq_p // block_q):
+            blk = _twin_cell(
+                qh[bh, qi * block_q:(qi + 1) * block_q, :],
+                kh[bh], vh[bh], qoff, koff, kvalid, qi,
+                causal=causal, scale=scale, block_k=block_k,
+            )
+            out = out.at[bh, qi * block_q:(qi + 1) * block_q, :].set(blk)
+    out = out.reshape(b, h, tq_p, d)
+    return out[:, :, :t_q]
+
+
+def _twin_cell(
+    q, kfull, vfull, qoff, koff, kvalid, qi, *, causal, scale, block_k
+):
+    """One grid cell of ``_kernel``, transliterated: ``pl.program_id(1)``
+    is ``qi``, refs are plain arrays, ``pl.ds`` is a slice — every
+    numeric op (and its order) is byte-identical to the kernel body."""
+    block_q, d = q.shape
+    t_k = kfull.shape[0]
+    n_kb = t_k // block_k
+    q_pos = (
+        qoff[0]
+        + qi * block_q
+        + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    )
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = lax.dynamic_slice(kfull, (kb * block_k, 0), (block_k, d))
+        vblk = lax.dynamic_slice(vfull, (kb * block_k, 0), (block_k, d))
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_idx = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        s = jnp.where(k_idx < kvalid[0], s, NEG_INF)
+        if causal:
+            k_pos = koff[0] + k_idx
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        blk_max = jnp.max(s, axis=1)
+        m_new = jnp.maximum(jnp.maximum(m, blk_max), -1e20)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr[:, None] + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    denom = jnp.where(l > 0, l, 1.0)
+    return (acc / denom[:, None]).astype(q.dtype)
+
+
 def _attention_bwd(
     q, k, v, o, do, q_offset, k_offset, causal, scale, block_k: int = 128
 ):

@@ -90,6 +90,9 @@ class Federation:
         self._selection_weights = [1.0] * config.n_stations
         self._staleness = [0] * config.n_stations
         self._admission_limited = False
+        # fused K-round dispatches driven through run_fused_rounds — the
+        # round index each dispatch's metrics record carries
+        self._fused_dispatches = 0
         # per-station LOCAL secrets (DH mask agreement, secureagg_dh):
         # generated here exactly as each real node would generate its own;
         # central/aggregator code has no accessor — partials reach their own
@@ -653,6 +656,69 @@ class Federation:
             "staleness": staleness,
             "quorum": quorum,
             "round_s": time.monotonic() - t0,
+        }
+
+    def run_fused_rounds(
+        self,
+        engine: Any,  # fed.fedavg.FedAvg (duck-typed: core stays light)
+        params: Any,
+        stacked_x: Any,
+        stacked_y: Any,
+        counts: Any,
+        key: Any,
+        n_rounds: int,
+        opt_state: Any = None,
+        donate: bool = True,
+        metrics: Any = None,  # runtime.metrics.MetricsLogger
+    ) -> dict[str, Any]:
+        """Thin host driver over the FUSED K-round device program
+        (docs/device_speed.md): ONE ``engine.run_rounds`` dispatch carries
+        this federation's CURRENT participation mask across all
+        ``n_rounds`` fused rounds, and the host pulls losses/stats back
+        once per dispatch instead of once per round. The roster is
+        sampled at dispatch time — a station going offline mid-dispatch
+        affects the NEXT dispatch, which is the fused program's
+        freshness/throughput trade (pick K accordingly).
+
+        ``metrics`` (a MetricsLogger) gets one ``round`` record per
+        dispatch with ``rounds_per_dispatch=n_rounds``, so per-logical-
+        round throughput stays comparable to the sequential driver.
+        Returns ``{"params", "opt_state", "losses", "stats",
+        "mask", "seconds", "rounds_per_sec"}``.
+        """
+        mask = self.participation_mask()
+        t0 = time.monotonic()
+        with TRACER.span(
+            "fused.rounds", kind="dispatch", service="federation",
+            attrs={"n_rounds": n_rounds,
+                   "online": int(float(jnp.sum(mask)))},
+        ):
+            if metrics is not None:
+                with metrics.round_timer(
+                    self._fused_dispatches, rounds_per_dispatch=n_rounds
+                ):
+                    out = engine.run_rounds(
+                        params, stacked_x, stacked_y, counts, key,
+                        n_rounds, mask=mask, opt_state=opt_state,
+                        donate=donate,
+                    )
+                    jax.block_until_ready(out[0])
+            else:
+                out = engine.run_rounds(
+                    params, stacked_x, stacked_y, counts, key, n_rounds,
+                    mask=mask, opt_state=opt_state, donate=donate,
+                )
+                jax.block_until_ready(out[0])
+        self._fused_dispatches += 1
+        dt = time.monotonic() - t0
+        return {
+            "params": out[0],
+            "opt_state": out[1],
+            "losses": out[2],
+            "stats": out[3],
+            "mask": mask,
+            "seconds": dt,
+            "rounds_per_sec": n_rounds / dt if dt > 0 else None,
         }
 
     # ------------------------------------------------------------- wait loop

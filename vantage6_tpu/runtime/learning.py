@@ -139,6 +139,7 @@ class RoundHistory:
         station_weights: Any = None,
         round_index: int | None = None,
         ts: float | None = None,
+        rounds_per_dispatch: int = 1,
     ) -> dict[str, Any]:
         """Record one round. Emits telemetry (``v6t_round_*`` /
         ``v6t_station_*``), a ``learning_round`` flight note, and a
@@ -196,6 +197,10 @@ class RoundHistory:
                 "station_norms": norms,
                 "station_cos": cosines,
             }
+            if rounds_per_dispatch != 1:
+                # this logical round arrived inside a fused K-round
+                # dispatch (FedAvg.run_rounds) — K rounds, one host pull
+                rec["rounds_per_dispatch"] = int(rounds_per_dispatch)
             if efs is not None:
                 rec["station_ef_norms"] = efs
             if weights is not None:
@@ -267,11 +272,17 @@ class RoundHistory:
         )
 
     def record_engine(
-        self, losses: Any, stats: dict[str, Any], start_round: int | None = None
+        self, losses: Any, stats: dict[str, Any],
+        start_round: int | None = None,
+        rounds_per_dispatch: int | None = None,
     ) -> list[dict[str, Any]]:
         """Host-record a FedAvg ``round()`` (scalar stats) or
         ``run_rounds()`` (scan-stacked ``[n, ...]`` stats) result. Pulls
-        the [S]-sized stat vectors to host — blocks on the device work."""
+        the [S]-sized stat vectors to host — blocks on the device work.
+        ``rounds_per_dispatch`` attributes each logical round to its host
+        dispatch (the fused program's K); by default it is inferred from
+        the stacked stats — a run_rounds result of n rounds IS one
+        n-round dispatch."""
         if not stats:
             return []
         gnorm = np.asarray(stats["update_norm"])
@@ -293,7 +304,15 @@ class RoundHistory:
                 station_weights=weights,
                 loss=None if loss_arr is None else loss_arr,
                 round_index=base,
+                rounds_per_dispatch=(
+                    1 if rounds_per_dispatch is None
+                    else int(rounds_per_dispatch)
+                ),
             )]
+        rpd = (
+            int(gnorm.shape[0]) if rounds_per_dispatch is None
+            else int(rounds_per_dispatch)
+        )
         return [
             self.record(
                 update_norm=gnorm[r],
@@ -303,6 +322,7 @@ class RoundHistory:
                 station_weights=None if weights is None else weights[r],
                 loss=None if loss_arr is None else loss_arr[r],
                 round_index=base + r,
+                rounds_per_dispatch=rpd,
             )
             for r in range(gnorm.shape[0])
         ]

@@ -171,11 +171,17 @@ class ObservedFunction:
         *,
         static_argnums: tuple[int, ...] = (),
         static_argnames: tuple[str, ...] = (),
+        sweep_statics: tuple[str, ...] = (),
         **jit_kwargs: Any,
     ):
         self.name = name
         self._static_argnums = tuple(static_argnums)
         self._static_argnames = tuple(static_argnames)
+        # statics a caller legitimately SWEEPS (e.g. the fused round
+        # program's n_rounds): a compile whose signature differs from a
+        # previously seen one ONLY in these keys is a planned new
+        # executable, not a retrace — it must not feed recompile_storm
+        self._sweep_statics = frozenset(sweep_statics)
         jit_kw: dict[str, Any] = dict(jit_kwargs)
         if self._static_argnums:
             jit_kw["static_argnums"] = self._static_argnums
@@ -197,12 +203,18 @@ class ObservedFunction:
         # feed recompile_storm, or the observatory would alert on churn
         # it created itself.
         self._seen_sigs: "OrderedDict[tuple, None]" = OrderedDict()
+        # guarded-by: _lock — signatures with sweep statics STRIPPED:
+        # membership here means "this shape was seen at SOME swept static
+        # value", the evidence that a new (avals, other-statics) miss is a
+        # static sweep rather than a shape-perturbing caller
+        self._seen_swept: "OrderedDict[tuple, None]" = OrderedDict()
         self._last_sig: tuple | None = None
         self._last_paths: list[str] = []
         self._last_avals: tuple = ()
         self._last_statics: tuple = ()
         self.compiles = 0
         self.retraces = 0
+        self.static_sweeps = 0
         self.dispatches = 0
         self.fallbacks = 0
         self.evictions = 0
@@ -231,6 +243,18 @@ class ObservedFunction:
         return tuple(dyn_args), dyn_kwargs, tuple(sorted(
             statics, key=lambda kv: kv[0]
         ))
+
+    def _swept_key(self, key: tuple) -> tuple | None:
+        """``key`` with the sweep statics stripped, or None when this
+        function declares none (or the key carries none of them)."""
+        if not self._sweep_statics:
+            return None
+        reduced = tuple(
+            kv for kv in key[2] if kv[0] not in self._sweep_statics
+        )
+        if reduced == key[2]:  # no swept static present in this call
+            return None
+        return (key[0], key[1], reduced)
 
     # ------------------------------------------------------------ dispatch
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -305,22 +329,35 @@ class ObservedFunction:
             paths = [jax.tree_util.keystr(p) for p, _ in flat]
         except Exception:
             paths = [f"leaf[{i}]" for i in range(len(avals))]
+        swept_key = self._swept_key(key)
         with self._lock:
             warm = bool(self._sigs) or self._last_sig is not None
             seen_before = key in self._seen_sigs
+            swept_before = (
+                swept_key is not None and swept_key in self._seen_swept
+            )
             old_paths, old_avals = self._last_paths, self._last_avals
             old_statics = self._last_statics
         retrace = warm and not seen_before
+        # a miss that matches a seen signature after stripping the SWEEP
+        # statics is a planned executable for a new static value (the
+        # fused program compiling for a new n_rounds) — real compile
+        # cost, attributed on the span, but NOT a retrace
+        static_sweep = retrace and swept_before
+        if static_sweep:
+            retrace = False
         changed = (
             _signature_diff(old_paths, old_avals, paths, avals,
                             old_statics, key[2])
-            if retrace else None
+            if (retrace or static_sweep) else None
         )
         attrs: dict[str, Any] = {
             "function": self.name,
             "n_leaves": len(avals),
             "retrace": retrace,
         }
+        if static_sweep:
+            attrs["static_sweep"] = True
         if seen_before:
             # recompiling a signature the FIFO evicted — raise
             # max_signatures (V6T_DEVICE_OBS_SIGS) if this is frequent
@@ -372,12 +409,20 @@ class ObservedFunction:
             self.retraces += 1
             REGISTRY.counter("v6t_jit_retraces_total").inc()
             DEVICE_OBS.record_retrace(self.name, changed or "?")
+        if static_sweep:
+            self.static_sweeps += 1
+            REGISTRY.counter("v6t_jit_static_sweeps_total").inc()
         with self._lock:
             self._sigs[key] = compiled
             self._seen_sigs[key] = None
             self._seen_sigs.move_to_end(key)
             while len(self._seen_sigs) > 1024:
                 self._seen_sigs.popitem(last=False)
+            if swept_key is not None:
+                self._seen_swept[swept_key] = None
+                self._seen_swept.move_to_end(swept_key)
+                while len(self._seen_swept) > 1024:
+                    self._seen_swept.popitem(last=False)
             self._last_sig = key
             self._last_paths, self._last_avals = paths, avals
             self._last_statics = key[2]
@@ -396,6 +441,7 @@ class ObservedFunction:
         with self._lock:
             self._sigs.clear()
             self._seen_sigs.clear()
+            self._seen_swept.clear()
             self._last_sig = None
             self._last_paths, self._last_avals = [], ()
             self._last_statics = ()
@@ -406,6 +452,7 @@ class ObservedFunction:
             "signatures": self.n_signatures(),
             "compiles": self.compiles,
             "retraces": self.retraces,
+            "static_sweeps": self.static_sweeps,
             "dispatches": self.dispatches,
             "fallbacks": self.fallbacks,
             "evictions": self.evictions,
@@ -537,15 +584,19 @@ def observed_jit(
     *,
     static_argnums: tuple[int, ...] = (),
     static_argnames: tuple[str, ...] = (),
+    sweep_statics: tuple[str, ...] = (),
     **jit_kwargs: Any,
 ) -> ObservedFunction:
     """``jax.jit`` with the device observatory attached (module doc).
     ``name`` is the low-cardinality label every compile span, retrace
     note and alert uses — name the OPERATION (``fedavg.round``), not the
-    call site."""
+    call site. ``sweep_statics`` names statics the caller legitimately
+    sweeps (the fused program's ``n_rounds``): compiles differing only in
+    those are counted as ``static_sweeps``, not retraces."""
     return DEVICE_OBS.register(ObservedFunction(
         name, fun, static_argnums=static_argnums,
-        static_argnames=static_argnames, **jit_kwargs,
+        static_argnames=static_argnames, sweep_statics=sweep_statics,
+        **jit_kwargs,
     ))
 
 
