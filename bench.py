@@ -159,6 +159,12 @@ OBS_WD_ARM_INTERVAL = 2.0  # watchdog cadence in the ON overhead arm — a
 OBS_WD_INTERVAL = 0.4      # watchdog eval cadence in the fault smoke
 OBS_WD_DEADLINE = 1.0      # stuck-run deadline in the smoke
 OBS_WD_PING_WINDOW = 1.2   # daemon_lapsed window in the smoke
+OBS_FLEET_PUSH_S = 0.5     # daemon fleet-push cadence in the fleet arm —
+                           # deliberately 30x the production default (15 s,
+                           # V6T_FLEET_PUSH_INTERVAL) so the <5%
+                           # fleet_overhead_pct budget is measured against
+                           # a HARDER duty cycle than any real deployment
+                           # pays
 # wire_format leg (binary wire PR): v1 JSON+base64 vs v2 framed-binary
 # (de)serialization throughput + on-wire bytes on model-weight pytrees and a
 # DataFrame stats table, plus single-pass broadcast encryption cost when the
@@ -1571,16 +1577,21 @@ def worker_observability() -> None:
     """observability leg: bare vs tracing vs full ops plane, alternated.
 
     The guardrail for the tracing PR, extended by the watchdog, device-
-    observatory and learning-plane PRs: five arms per rep — "off"
-    (bare), "trace" (distributed tracing, the PR-5 configuration, so
-    overhead_pct keeps its historical meaning), "ops" (tracing +
+    observatory, learning-plane and fleet-fabric PRs: six arms per rep —
+    "off" (bare), "trace" (distributed tracing, the PR-5 configuration,
+    so overhead_pct keeps its historical meaning), "ops" (tracing +
     watchdog at an operator cadence + structured JSON logging + flight
     taps), "obsy" (ops + device observatory), "learn" (ops + learning
-    plane: per-task round recording + /api/rounds). Arms alternate and
+    plane: per-task round recording + /api/rounds), "fleet" (ops +
+    daemon fleet pushes at a 30x-production cadence + the store-backed
+    SLO engine evaluating on every watchdog tick). Arms alternate and
     compare best-of so a host-load spike doesn't masquerade as
     instrumentation overhead; ops_overhead_pct (ops vs trace) is the
     watchdog PR's <5% acceptance, learning_overhead_pct (learn vs ops)
-    the learning-plane PR's. The learning_anomaly smoke seeds a
+    the learning-plane PR's, fleet_overhead_pct (fleet vs ops) the
+    fleet-fabric PR's. The fleet arm also asserts the cross-host census:
+    every daemon AND the server itself must appear as fresh sources in
+    GET /api/fleet after the timed window. The learning_anomaly smoke seeds a
     label-flipped station in an engine run and asserts anomalous_station
     names it within one watchdog interval, with fp32-identical stats
     between replicated and scattered update paths.
@@ -1674,21 +1685,29 @@ def worker_observability() -> None:
         # "learn" (ops + the learning plane armed: per-task round
         # recording into LEARNING + the /api/rounds surface —
         # learning_overhead_pct vs the ops arm isolates the learning-
-        # plane instrumentation, the learning-plane PR's <5% acceptance)
+        # plane instrumentation, the learning-plane PR's <5% acceptance),
+        # "fleet" (ops + every daemon pushing telemetry snapshots at
+        # OBS_FLEET_PUSH_S + the server self-ingesting and the SLO burn-
+        # rate engine evaluating store-backed history on each watchdog
+        # tick — fleet_overhead_pct vs the ops arm isolates the fleet
+        # fabric, the fleet-fabric PR's <5% acceptance)
         tracing_on = mode != "off"
         TRACER.configure(enabled=tracing_on, sample=1.0)
         TRACER.clear()
         DEVICE_OBS.configure(enabled=mode == "obsy")
         if mode == "learn":
             LEARNING.clear()
-        if mode in ("ops", "obsy", "learn"):
+        if mode in ("ops", "obsy", "learn", "fleet"):
             WATCHDOG.configure(interval=OBS_WD_ARM_INTERVAL)
             enable_json_sink(os.path.join(tmp, f"log-{arm_tag}.jsonl"))
         else:
             WATCHDOG.configure(interval=60.0)  # effectively idle
             disable_json_sink()
+        daemon_kw: dict = {"poll_interval": 0.25}
+        if mode == "fleet":
+            daemon_kw["fleet_push_interval"] = OBS_FLEET_PUSH_S
         srv, http, client, orgs, collab, daemons = boot_stack(
-            f"obs-{arm_tag}", n_daemons, poll_interval=0.25,
+            f"obs-{arm_tag}", n_daemons, **daemon_kw,
         )
         org_ids = [o["id"] for o in orgs]
         parity = True
@@ -1754,6 +1773,27 @@ def worker_observability() -> None:
             out["rounds_index_ok"] = any(
                 t2.get("task") == last_learn_task
                 for t2 in idx.get("tasks") or []
+            )
+        if mode == "fleet":
+            # outside the timed window: the cross-host census acceptance —
+            # every daemon's pushes AND the server's self-ingested snapshot
+            # must read back as fresh sources from GET /api/fleet
+            view = client.util.fleet()
+            srcs = view.get("sources") or []
+            n_daemon_srcs = sum(
+                1 for s in srcs if s.get("service") == "daemon"
+            )
+            metrics_text = client.util.metrics()
+            out["fleet_sources"] = len(srcs)
+            out["fleet_daemon_sources"] = n_daemon_srcs
+            out["fleet_census_ok"] = (
+                n_daemon_srcs == n_daemons
+                and any(s.get("service") == "server" for s in srcs)
+                and not any(s.get("stale") for s in srcs)
+            )
+            out["slo_engine_ok"] = (
+                "v6t_slo_evaluations_total" in metrics_text
+                and "v6t_fleet_ingests_total" in metrics_text
             )
         if tracing_on and last_trace is not None:
             spans = TRACER.drain(last_trace)
@@ -2162,7 +2202,7 @@ def worker_observability() -> None:
         return out
 
     try:
-        offs, ons, opss, obsys, learns = [], [], [], [], []
+        offs, ons, opss, obsys, learns, fleets = [], [], [], [], [], []
         traced: dict = {}
         for rep in range(max(1, int(os.environ.get(
             "BENCH_OBS_REPS", str(OBS_REPS)
@@ -2174,6 +2214,7 @@ def worker_observability() -> None:
             opss.append(arm("ops", f"ops{rep}"))
             obsys.append(arm("obsy", f"obsy{rep}"))
             learns.append(arm("learn", f"learn{rep}"))
+            fleets.append(arm("fleet", f"fleet{rep}"))
         watchdog_smoke = fault_smoke()
         storm_smoke = retrace_storm_smoke()
         anomaly_smoke = learning_anomaly_smoke()
@@ -2189,6 +2230,7 @@ def worker_observability() -> None:
     best_ops = max(a["tasks_per_sec"] for a in opss)
     best_obsy = max(a["tasks_per_sec"] for a in obsys)
     best_learn = max(a["tasks_per_sec"] for a in learns)
+    best_fleet = max(a["tasks_per_sec"] for a in fleets)
     overhead_pct = round(100.0 * (best_off - best_on) / best_off, 2)
     # what the WATCHDOG PR adds on top of tracing (the "<5% watchdog +
     # JSON logging" acceptance): ops arm vs trace arm, best-of each
@@ -2198,10 +2240,16 @@ def worker_observability() -> None:
     observatory_overhead_pct = round(
         100.0 * (best_ops - best_obsy) / best_ops, 2
     )
-    # what the LEARNING PLANE adds on top of the full ops plane (this
-    # PR's <5% acceptance): learn arm vs ops arm, best-of each
+    # what the LEARNING PLANE adds on top of the full ops plane (the
+    # learning-plane PR's <5% acceptance): learn arm vs ops arm
     learning_overhead_pct = round(
         100.0 * (best_ops - best_learn) / best_ops, 2
+    )
+    # what the FLEET FABRIC adds on top of the full ops plane (this PR's
+    # <5% acceptance): fleet arm (pushes at 30x-production cadence + SLO
+    # engine reading store history every tick) vs ops arm, best-of each
+    fleet_overhead_pct = round(
+        100.0 * (best_ops - best_fleet) / best_ops, 2
     )
     print(json.dumps({
         "n_daemons": n_daemons,
@@ -2222,6 +2270,9 @@ def worker_observability() -> None:
         ),
         "learning_overhead_pct": learning_overhead_pct,
         "learning_overhead_ok": learning_overhead_pct < OBS_OVERHEAD_PCT,
+        "tasks_per_sec_fleet_plane": best_fleet,
+        "fleet_overhead_pct": fleet_overhead_pct,
+        "fleet_overhead_ok": fleet_overhead_pct < OBS_OVERHEAD_PCT,
         "overhead_budget_pct": OBS_OVERHEAD_PCT,
         "ops_plane_in_ops_arm": ["tracing", "watchdog", "json_logging",
                                  "flight_taps"],
@@ -2229,12 +2280,23 @@ def worker_observability() -> None:
         "learning_plane_in_learn_arm": [
             "ops_plane", "round_recording", "rounds_api",
         ],
+        "fleet_fabric_in_fleet_arm": [
+            "ops_plane", "daemon_fleet_push", "server_self_ingest",
+            "slo_burn_rate_engine",
+        ],
+        "fleet_push_interval_s": OBS_FLEET_PUSH_S,
+        "fleet_census_ok": all(a.get("fleet_census_ok") for a in fleets),
+        "fleet_slo_engine_ok": all(
+            a.get("slo_engine_ok") for a in fleets
+        ),
+        "fleet_sources_last_arm": fleets[-1].get("fleet_sources"),
         "rounds_endpoint_ok": all(
             a.get("rounds_endpoint_ok") and a.get("rounds_index_ok")
             for a in learns
         ),
         "parity_ok": all(
-            a["parity_ok"] for a in offs + ons + opss + obsys + learns
+            a["parity_ok"]
+            for a in offs + ons + opss + obsys + learns + fleets
         ),
         "trace": {
             k: traced.get(k)
@@ -3037,17 +3099,45 @@ def main() -> None:
         "budget_s": BENCH_BUDGET_S,
     }
     legs_done: list[str] = []
+    bench_notes: list[dict] = []
+
+    def leg_note(kind: str, leg: str, **fields) -> None:
+        """One flight-note-shaped record (`{"type": "note", ts, kind,
+        ...}` — the flight recorder's on-disk shape, built by hand
+        because the bench parent must never import the package, whose
+        __init__ pulls jax). `v6t_bench_leg_*` kinds classify WHY a leg
+        has no number, next to the numbers the round degraded to."""
+        bench_notes.append({
+            "type": "note", "ts": round(time.time(), 3),
+            "kind": kind, "leg": leg, **fields,
+        })
 
     def leg_marker(name: str, result: dict | None, diag: str) -> str:
         """ok / ':skipped' (never started: budget or no-TPU) / ':failed'
         (started and crashed/timed out) — the artifact must not conflate
-        'investigate this' with 'expected budget behavior'."""
+        'investigate this' with 'expected budget behavior'. Every leg's
+        outcome also lands as a v6t_bench_leg_* note (wedge and timeout
+        distinguished from plain crashes) so bench_trend/doctor can
+        explain a degraded round, not just show its hole."""
         if result is not None:
+            leg_note("v6t_bench_leg_ok", name)
             return name
-        return name + (":skipped" if diag.startswith("skipped") else ":failed")
+        if diag.startswith("skipped"):
+            leg_note("v6t_bench_leg_skipped", name, diag=diag)
+            return name + ":skipped"
+        if "fault-injected wedge" in diag:
+            leg_note("v6t_bench_leg_wedge", name, diag=diag)
+        elif "timeout after" in diag:
+            leg_note("v6t_bench_leg_timeout", name, diag=diag)
+        else:
+            leg_note("v6t_bench_leg_failed", name, diag=diag)
+        return name + ":failed"
 
     ckpt_path = os.environ.get("BENCH_CHECKPOINT") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_CHECKPOINT.json"
+    )
+    notes_path = os.environ.get("BENCH_FLIGHT_NOTES") or os.path.join(
+        os.path.dirname(ckpt_path), "BENCH_FLIGHT.jsonl"
     )
 
     def emit(partial: bool = True) -> None:
@@ -3060,6 +3150,21 @@ def main() -> None:
         must degrade the checkpoint, never the bench."""
         out["elapsed_s"] = round(time.monotonic() - t_start, 1)
         out["legs_done"] = list(legs_done)
+        # why a leg has no number, in the artifact itself: counts per
+        # v6t_bench_leg_* kind, the non-ok legs by name, and the notes
+        # (flight-note-shaped; also mirrored to a doctor-readable JSONL)
+        by_kind: dict[str, int] = {}
+        for n in bench_notes:
+            by_kind[n["kind"]] = by_kind.get(n["kind"], 0) + 1
+        out["bench_health"] = {
+            "by_kind": by_kind,
+            "degraded_legs": sorted({
+                n["leg"] for n in bench_notes
+                if n["kind"] != "v6t_bench_leg_ok"
+            }),
+            "notes": bench_notes,
+            "flight_notes_path": notes_path,
+        }
         out["partial"] = partial
         line = json.dumps(out)
         print(line, flush=True)
@@ -3072,11 +3177,26 @@ def main() -> None:
             os.replace(tmp, ckpt_path)
         except OSError:
             pass
+        try:
+            # the same notes as a flight-bundle-shaped JSONL, so
+            # `tools/doctor.py BENCH_FLIGHT.jsonl` renders a wedged
+            # round's story with the tooling operators already know.
+            # Fail-soft like the checkpoint.
+            with open(notes_path, "w") as fh:
+                for rec in bench_notes:
+                    fh.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
 
     emit()  # a kill during the probe still leaves a parseable line
 
     tpu_ok, tpu_why = probe_tpu(timeout_s=leg_timeout(PROBE_TIMEOUT_S))
     out["tpu"] = "ok" if tpu_ok else f"unavailable: {tpu_why}"
+    if not tpu_ok:
+        # the whole round will run its device legs on CPU: the single
+        # most common "why is this round slower" answer, on the record
+        leg_note("v6t_bench_leg_degraded_cpu", "probe",
+                 diag=f"tpu unavailable: {tpu_why}")
     legs_done.append("probe")
     emit()
 
@@ -3096,6 +3216,11 @@ def main() -> None:
         # and accuracy-gap comparisons stay apples-to-apples; the output
         # labels the degraded config via "stations"/"degraded_cpu".
         degraded_cpu = True
+        leg_note(
+            "v6t_bench_leg_degraded_cpu", "spmd",
+            diag=f"TPU path failed ({spmd_diag}); rerunning on the fake "
+                 f"CPU pod at {SPMD_CPU_STATIONS} stations",
+        )
         spmd, spmd_diag = _run_worker(
             "spmd", force_cpu=True,
             timeout_s=leg_timeout(SPMD_CPU_TIMEOUT_S),

@@ -1,0 +1,474 @@
+"""Fleet telemetry fabric: store-backed cross-host aggregation, SLO
+burn-rate alerting, and the live fleet doctor.
+
+docs/observability.md "fleet fabric": daemons and Federation processes
+ship compact telemetry snapshots to `POST /api/telemetry`; snapshots
+land as CAS-free appends in the fleet tables through the PR-11
+StorageBackend, so N replicas over one shared ``sqlite+wal`` store
+serve ONE coherent fleet view from `GET /api/fleet`. The SLO engine
+(runtime/watchdog.py) evaluates declarative objectives over that
+store-backed history with multi-window burn-rate alerting.
+
+What must hold:
+
+- a snapshot pushed from daemon A through replica 1 is visible in
+  `GET /api/fleet` on replica 2 (and vice versa) — one census, not
+  per-replica shards;
+- retention pruning deletes past the floor but keeps the newest row
+  per (source, series), so quiet sources stay visible as stale;
+- a seeded fast-burn raises the SLO alert (naming objective + window)
+  within one evaluation; sporadic fast-window noise against a healthy
+  slow window stays quiet (the multi-window AND);
+- `doctor --live` names the burning SLO and the lagging source;
+- a FleetPusher against a pre-fleet server (404 on /api/telemetry)
+  pins itself off — capability-gated no-op, not an error spam loop.
+"""
+import time
+
+import pytest
+
+from vantage6_tpu.common.fleet import (
+    FleetPusher,
+    build_snapshot,
+    compact_metrics,
+    decode_push,
+    encode_push,
+)
+from vantage6_tpu.common.rest import RestError
+from vantage6_tpu.common.telemetry import REGISTRY
+from vantage6_tpu.runtime.watchdog import (
+    RULE_CATALOG,
+    RuleContext,
+    Watchdog,
+    default_slos,
+)
+from vantage6_tpu.server import fleet as fleet_store
+from vantage6_tpu.server.app import ServerApp
+
+SECRET = "fleet-shared-jwt-secret"
+ROOT_PW = "rootpass123"
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    uri = "sqlite+wal:///" + str(tmp_path / "cp.db")
+    a = ServerApp(uri=uri, jwt_secret=SECRET, replica_id="replica-a")
+    b = ServerApp(uri=uri, jwt_secret=SECRET, replica_id="replica-b")
+    a.ensure_root(password=ROOT_PW)
+    yield a, b
+    b.close()
+    a.close()
+
+
+def _root(srv: ServerApp):
+    c = srv.test_client()
+    r = c.post("/api/token/user", {"username": "root", "password": ROOT_PW})
+    assert r.status == 200, r
+    c.token = r.json["access_token"]
+    return c
+
+
+def _node_client(root_client, srv: ServerApp):
+    org = root_client.post("/api/organization", {"name": "fleet_org"}).json
+    collab = root_client.post(
+        "/api/collaboration",
+        {"name": "fleet", "organization_ids": [org["id"]]},
+    ).json
+    node = root_client.post(
+        "/api/node",
+        {"organization_id": org["id"], "collaboration_id": collab["id"]},
+    ).json
+    c = srv.test_client()
+    r = c.post("/api/token/node", {"api_key": node["api_key"]})
+    assert r.status == 200, r
+    c.token = r.json["access_token"]
+    return c
+
+
+def _payload(source: str, metrics: dict, notes=(), service="daemon",
+             seq=0, ts=None):
+    return {
+        "source": source,
+        "service": service,
+        "seq": seq,
+        "ts": ts if ts is not None else time.time(),
+        "metrics": metrics,
+        "notes": list(notes),
+    }
+
+
+# ------------------------------------------------------------ wire + ingest
+class TestPushWire:
+    def test_encode_decode_round_trip(self):
+        payload = _payload("daemon:x", {"v6t_rest_calls_total": 7.0},
+                           notes=[{"kind": "fleet_test", "ts": 1.0}])
+        back = decode_push(encode_push(payload))
+        assert back["source"] == "daemon:x"
+        assert back["metrics"]["v6t_rest_calls_total"] == 7.0
+        assert back["notes"][0]["kind"] == "fleet_test"
+
+    def test_decode_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            decode_push({"blob": "not base64!!", "encoding": "wire+b64"})
+        with pytest.raises(ValueError):
+            decode_push({"encoding": "wire+b64"})
+        with pytest.raises(ValueError):
+            # decodes, but carries no source stamp
+            decode_push(encode_push({"metrics": {}}))
+
+    def test_build_snapshot_folds_histograms(self):
+        REGISTRY.histogram("v6t_run_dispatch_seconds").observe(0.25)
+        snap = build_snapshot("daemon:y", "daemon", seq=3)
+        assert snap["source"] == "daemon:y" and snap["seq"] == 3
+        m = snap["metrics"]
+        # histograms ship as _sum/_count scalars, never bucket dicts
+        assert "v6t_run_dispatch_seconds_sum" in m
+        assert "v6t_run_dispatch_seconds_count" in m
+        assert all(isinstance(v, float) for v in m.values())
+        folded = compact_metrics()
+        assert "v6t_run_dispatch_seconds" not in folded
+
+
+class TestCrossReplicaIngest:
+    def test_push_through_either_replica_one_census(self, pair):
+        """The acceptance path: two daemon snapshots pushed through
+        DIFFERENT replicas of one shared WAL store read back as ONE
+        coherent fleet census from both replicas' GET /api/fleet."""
+        a, b = pair
+        ca = _root(a)
+        na = _node_client(ca, a)
+        # the same node principal authenticates against replica B too —
+        # one shared store, one credential set
+        nb = b.test_client()
+        nb.token = na.token
+        # a name only THIS test pushes: the replicas self-ingest their
+        # own registry (which carries real v6t_* totals from the rest
+        # of the suite), so a shared name's census sum is unpredictable
+        probe = "v6t_fleet_probe_calls_total"
+        r1 = na.post("/api/telemetry", encode_push(_payload(
+            "daemon:alpha", {probe: 10.0},
+            notes=[{"kind": "fleet_test_note", "ts": time.time()}],
+        )))
+        assert r1.status == 201, r1
+        assert r1.json["accepted"] and r1.json["metrics"] == 1
+        assert r1.json["events"] == 1
+        r2 = nb.post("/api/telemetry", encode_push(_payload(
+            "daemon:beta", {probe: 4.0}, seq=2,
+        )))
+        assert r2.status == 201, r2
+        for srv in (a, b):
+            view = srv.test_client().get("/api/fleet").json
+            names = {s["source"] for s in view["sources"]}
+            assert {"daemon:alpha", "daemon:beta"} <= names
+            assert view["liveness"]["daemons"] >= 2
+            # undeclared names merge as gauges (sample_kind's
+            # conservative default); still summed across sources
+            assert view["census"]["gauges"][probe] == 14.0
+            assert any(e["kind"] == "fleet_test_note"
+                       for e in view["events"])
+
+    def test_bad_push_is_a_400_not_a_crash(self, pair):
+        a, _b = pair
+        na = _node_client(_root(a), a)
+        r = na.post("/api/telemetry", {"blob": "@@not-wire@@"})
+        assert r.status == 400
+        r = na.post("/api/telemetry", ["not", "a", "dict"])
+        assert r.status == 400
+
+    def test_push_requires_a_principal(self, pair):
+        a, _b = pair
+        c = a.test_client()
+        r = c.post("/api/telemetry", encode_push(_payload(
+            "daemon:anon", {"v6t_rest_calls_total": 1.0},
+        )))
+        assert r.status == 401
+
+    def test_health_carries_the_fleet_block(self, pair):
+        a, b = pair
+        na = _node_client(_root(a), a)
+        na.post("/api/telemetry", encode_push(_payload(
+            "daemon:alpha", {"v6t_rest_calls_total": 1.0},
+        )))
+        health = b.test_client().get("/api/health").json
+        assert health["fleet"]["sources"] >= 1
+        assert health["fleet"]["url"] == "/api/fleet"
+
+
+class TestRetention:
+    def test_prune_keeps_newest_row_per_source_series(self, pair):
+        a, _b = pair
+        now = time.time()
+        old = now - fleet_store.RETENTION_S - 60.0
+        for ts, value in ((old, 1.0), (old + 1.0, 2.0)):
+            a.db.execute(
+                "INSERT INTO fleet_metric "
+                "(source, service, seq, name, kind, value, ts) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                ["daemon:quiet", "daemon", 0, "v6t_rest_calls_total",
+                 "counter", value, ts],
+            )
+        fleet_store.record_sample(
+            a.db, "daemon:busy", "daemon", "v6t_rest_calls_total", 9.0
+        )
+        deleted = fleet_store.prune(a.db, now)
+        assert deleted == 1  # the older of the two expired rows
+        rows = a.db.query(
+            # the replicas self-ingest their own snapshots on watchdog
+            # ticks; scope to the two hand-seeded daemon sources
+            "SELECT source, value FROM fleet_metric "
+            "WHERE source LIKE 'daemon:%' ORDER BY source"
+        )
+        by_src = {r["source"]: r["value"] for r in rows}
+        # the quiet source survives as its newest sample -> visible as
+        # STALE in the census instead of vanishing
+        assert by_src == {"daemon:quiet": 2.0, "daemon:busy": 9.0}
+        srcs = {s["source"]: s for s in fleet_store.sources(a.db, now)}
+        assert srcs["daemon:quiet"]["stale"]
+        assert not srcs["daemon:busy"]["stale"]
+
+
+# --------------------------------------------------------------- SLO engine
+def _ctx(feeds=None, config=None, now=None):
+    w = Watchdog(interval=60.0)
+    cfg = dict(w.config)
+    cfg.update(config or {})
+    return RuleContext(
+        {}, {}, feeds or {}, cfg, now if now is not None else time.time()
+    )
+
+
+def _slo_rule(name):
+    return next(s for s in default_slos() if s.name == name).to_alert_rule()
+
+
+def _dispatch_samples(now, ages_values, source="daemon:slow"):
+    return [
+        {"metric": "v6t_run_dispatch_seconds", "source": source,
+         "ts": now - age, "value": v}
+        for age, v in ages_values
+    ]
+
+
+class TestSloBurnRate:
+    CFG = {
+        "slo_dispatch_target_s": 0.5,
+        "slo_error_budget": 0.25,
+        "slo_burn_threshold": 2.0,
+        "slo_fast_window_s": 60.0,
+        "slo_slow_window_s": 600.0,
+        "slo_min_samples": 4,
+    }
+
+    def test_fast_burn_fires_naming_objective_and_window(self):
+        now = time.time()
+        bad = _dispatch_samples(now, [(i + 1.0, 5.0) for i in range(6)])
+        c = _ctx(feeds={"srv": {"slo_dispatch": bad}},
+                 config=self.CFG, now=now)
+        found = _slo_rule("slo_dispatch_latency").check(c)
+        assert len(found) == 1
+        msg = found[0]["message"]
+        assert "99% of run dispatches" in msg       # names the objective
+        assert "fast 60s window" in msg             # names the window
+        assert "slow 600s window" in msg
+        assert "worst source daemon:slow" in msg    # names the offender
+        assert found[0]["labels"] == {"slo": "slo_dispatch_latency"}
+
+    def test_slow_noise_stays_quiet(self):
+        """Sporadic over-target samples inside the fast window burn fast
+        but not slow — the multi-window AND keeps the alert quiet."""
+        now = time.time()
+        noise = _dispatch_samples(now, [(i + 1.0, 5.0) for i in range(4)])
+        healthy = _dispatch_samples(
+            now, [(120.0 + i, 0.01) for i in range(28)],
+            source="daemon:fine",
+        )
+        c = _ctx(feeds={"srv": {"slo_dispatch": noise + healthy}},
+                 config=self.CFG, now=now)
+        assert _slo_rule("slo_dispatch_latency").check(c) == []
+
+    def test_replica_duplicate_samples_do_not_double_count(self):
+        """Two replicas feed the same shared store: identical samples
+        arriving through both feeds must dedupe, or every burn rate
+        doubles on a 2-replica deployment."""
+        now = time.time()
+        bad = _dispatch_samples(now, [(i + 1.0, 5.0) for i in range(6)])
+        c = _ctx(
+            feeds={"replica-a": {"slo_dispatch": bad},
+                   "replica-b": {"slo_dispatch": list(bad)}},
+            config=self.CFG, now=now,
+        )
+        found = _slo_rule("slo_dispatch_latency").check(c)
+        assert len(found) == 1
+        assert "(6 samples)" in found[0]["message"]
+
+    def test_no_feed_proposes_nothing(self):
+        # a daemon-side watchdog has no fleet feed: SLO rules must stay
+        # silent, not crash or alert on emptiness
+        c = _ctx(config=self.CFG)
+        for slo in default_slos():
+            assert slo.to_alert_rule().check(c) == []
+
+    def test_throughput_collapse_fires_only_with_baseline(self):
+        now = time.time()
+        cfg = dict(self.CFG, slo_throughput_floor_pct=50.0)
+        # cumulative counter: +1 round/s for 10 min, then flatlines for
+        # the whole fast window
+        hist = [
+            {"metric": "v6t_round_updates_total", "source": "srv",
+             "ts": now - age, "value": 600.0 - age}
+            for age in (590.0, 400.0, 200.0, 70.0)
+        ]
+        flat = [
+            {"metric": "v6t_round_updates_total", "source": "srv",
+             "ts": now - age, "value": 530.0}
+            for age in (50.0, 5.0)
+        ]
+        rule = _slo_rule("slo_round_throughput")
+        c = _ctx(feeds={"f": {"slo_rounds": hist + flat}},
+                 config=cfg, now=now)
+        found = rule.check(c)
+        assert len(found) == 1
+        assert "round throughput" in found[0]["message"]
+        assert "below 50%" in found[0]["message"]
+        # without an established slow-window baseline: quiet, whatever
+        # the fast window does
+        c2 = _ctx(feeds={"f": {"slo_rounds": flat}}, config=cfg, now=now)
+        assert rule.check(c2) == []
+
+    def test_liveness_grace_separates_restart_from_outage(self):
+        cfg = dict(self.CFG, slo_liveness_ratio=0.75,
+                   slo_liveness_slow_grace_s=120.0)
+        rule = _slo_rule("slo_daemon_liveness")
+
+        def census(age):
+            return [
+                {"source": "daemon:ok", "service": "daemon",
+                 "age_s": 1.0, "stale": False},
+                {"source": "daemon:gone", "service": "daemon",
+                 "age_s": age, "stale": True},
+            ]
+
+        # stale past the grace: a real outage, burn in BOTH windows
+        c = _ctx(feeds={"f": {"fleet_sources": census(500.0)}}, config=cfg)
+        found = rule.check(c)
+        assert len(found) == 1
+        assert "most lagging: daemon:gone" in found[0]["message"]
+        # stale but within the grace (a restart): fast burn only -> quiet
+        c2 = _ctx(feeds={"f": {"fleet_sources": census(60.0)}}, config=cfg)
+        assert rule.check(c2) == []
+
+    def test_default_slos_are_cataloged(self):
+        for slo in default_slos():
+            assert slo.name in RULE_CATALOG
+            assert slo.name.startswith("slo_")
+
+
+# ----------------------------------------------------- live server + doctor
+class TestLiveFleet:
+    def test_seeded_fast_burn_raises_within_one_evaluation_and_doctor_live(
+        self, tmp_path, capsys
+    ):
+        """End to end on a real HTTP server: seed an over-target dispatch
+        series in the store, one watchdog evaluation raises the SLO alert
+        naming the objective and window, and `doctor --live` renders the
+        burning SLO + the lagging source. Clearing the series clears the
+        alert on the next clean evaluation (no state bleeds out)."""
+        uri = "sqlite+wal:///" + str(tmp_path / "live.db")
+        srv = ServerApp(uri=uri, jwt_secret=SECRET, replica_id="live-a")
+        srv.ensure_root(password=ROOT_PW)
+        http = srv.serve(port=0, background=True)
+        keep = {k: srv.watchdog.config[k]
+                for k in ("slo_burn_threshold", "slo_min_samples")}
+        try:
+            for _ in range(6):
+                fleet_store.record_sample(
+                    srv.db, "daemon:slowpoke", "daemon",
+                    "v6t_run_dispatch_seconds", 9.5,
+                )
+            srv.watchdog.configure(slo_burn_threshold=1.5,
+                                   slo_min_samples=2)
+            active = srv.watchdog.evaluate()  # ONE evaluation suffices
+            slo = next(
+                a for a in active if a["rule"] == "slo_dispatch_latency"
+            )
+            assert "99% of run dispatches" in slo["message"]
+            assert "window" in slo["message"]
+            assert "daemon:slowpoke" in slo["message"]
+
+            import tools.doctor as doctor
+
+            rc = doctor.main(["--live", http.url])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "fleet digest:" in out
+            assert "BURNING SLO" in out
+            assert "slo_dispatch_latency" in out          # names the SLO
+            # the burn message names the offender; the digest names the
+            # most-lagging source (may be the server's own self-ingest)
+            assert "worst source daemon:slowpoke" in out
+            assert "lagging source:" in out
+        finally:
+            # recovery path doubles as cleanup: drop the seeded series,
+            # restore thresholds, and the next clean pass clears the alert
+            srv.db.execute(
+                "DELETE FROM fleet_metric "
+                "WHERE name = 'v6t_run_dispatch_seconds'"
+            )
+            srv.watchdog.configure(**keep)
+            remaining = {
+                a["rule"] for a in srv.watchdog.evaluate()
+            }
+            http.stop()
+            srv.close()
+        assert "slo_dispatch_latency" not in remaining
+
+    def test_doctor_live_unreachable_server_degrades(self, capsys):
+        import tools.doctor as doctor
+
+        rc = doctor.main(["--live", "http://127.0.0.1:9"])  # nothing there
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "cannot poll" in err
+
+
+# -------------------------------------------------- pre-fleet server interop
+class TestCapabilityPin:
+    def test_404_pins_the_pusher_off(self):
+        calls = []
+
+        def request(method, endpoint, json_body=None, **kw):
+            calls.append(endpoint)
+            raise RestError(404, "no such route")
+
+        p = FleetPusher("daemon:old", "daemon", request, interval=0.05)
+        before = REGISTRY.counter("v6t_fleet_push_unsupported_total").value
+        assert p.push() is False
+        assert p.supported is False
+        assert "pinned off" in p.last_error
+        after = REGISTRY.counter("v6t_fleet_push_unsupported_total").value
+        assert after == before + 1
+        time.sleep(0.06)
+        # pinned: due() says no, maybe_push() never touches the wire again
+        assert p.due() is False
+        assert p.maybe_push() is False
+        assert calls == ["telemetry"]
+
+    def test_transient_error_retries_after_interval(self):
+        boom = [True]
+        accepted = []
+
+        def request(method, endpoint, json_body=None, **kw):
+            if boom[0]:
+                raise RestError(503, "try later")
+            accepted.append(decode_push(json_body)["source"])
+
+        p = FleetPusher("daemon:new", "daemon", request, interval=0.05)
+        assert p.push() is False
+        assert p.supported is None  # transient, NOT pinned
+        boom[0] = False
+        time.sleep(0.06)
+        assert p.maybe_push() is True
+        assert p.supported is True
+        assert accepted == ["daemon:new"]
+        # seq advances only on accepted pushes
+        assert p._seq == 1

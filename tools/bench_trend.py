@@ -89,6 +89,10 @@ HEADLINES: list[tuple[str, str, str]] = [
     # rounds, where FLOPs/peak is not meaningful), so CPU rounds show "—".
     ("fused_rounds_per_sec", "higher", "fused"),
     ("fused_mfu_vs_v5e_bf16_peak", "higher", "fused"),
+    # fleet telemetry fabric: what arming fleet pushes + the SLO engine
+    # adds on top of the ops arm (<5% budget is the bench leg's own hard
+    # gate; the non-positive-baseline skip applies like other overheads)
+    ("fleet_overhead_pct", "lower", "observability"),
 ]
 
 _NUM_RE = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
@@ -280,6 +284,17 @@ def main(argv: list[str]) -> int:
                 print(f"  {r}")
         else:
             print("\nno regression vs best prior same-platform round")
+    if rounds[-1]["invalid"]:
+        # the LATEST round being unreadable/empty is a failure in its own
+        # right, not just an advisory footnote: a wedged bench that wrote
+        # no parseable JSON must fail the trend gate, or a regression can
+        # hide behind its own crash
+        print(
+            f"\nLATEST ROUND INVALID: {rounds[-1]['file']}: "
+            f"{rounds[-1].get('note') or 'no headline values'}",
+            file=sys.stderr,
+        )
+        return 1
     return 1 if regs else 0
 
 

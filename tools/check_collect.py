@@ -97,6 +97,12 @@ _ROUTE_AUDIT: dict[str, list[str]] = {
     # learning plane (docs/observability.md "learning plane"): per-task
     # round histories the client util reads (index + per-task routes)
     "rounds": ["vantage6_tpu/client/client.py"],
+    # fleet fabric (docs/observability.md "fleet fabric"): telemetry is
+    # the push ingest every FleetPusher POSTs to; fleet is the merged
+    # cross-host view the client util (and doctor --live, checked in
+    # check_fleet_fabric — tools/ is outside this index) reads back
+    "telemetry": ["vantage6_tpu/common/fleet.py"],
+    "fleet": ["vantage6_tpu/client/client.py"],
 }
 
 
@@ -418,6 +424,106 @@ def check_alert_rules() -> list[str]:
                 f"alert rule {rule.name!r} missing from RULE_CATALOG "
                 "(doctor.py would render it unexplained)"
             )
+    return problems
+
+
+def check_fleet_fabric() -> list[str]:
+    """Audit the fleet telemetry fabric (common/fleet.py, server/fleet.py,
+    runtime/watchdog.py SLO engine, docs/observability.md "fleet fabric"):
+
+    - every ``v6t_fleet_*`` / ``v6t_slo_*`` metric declared in
+      KNOWN_METRICS is actually emitted by one of the fabric's modules
+      (string literal), and every such literal those modules emit is
+      declared — the same both-direction drift gate every other plane
+      has;
+    - every default SLO (``default_slos()``) compiles to a rule present
+      in RULE_CATALOG — deleting the ``default_rules()`` splice would
+      silently disarm burn-rate alerting while the SLO table still
+      parses;
+    - the ``/api/telemetry`` and ``/api/fleet`` routes are in the
+      route-audit map above (endpoint/call-site agreement), and
+      ``tools/doctor.py`` still references the ``fleet`` endpoint — the
+      live doctor is outside the package index the route audit walks.
+    """
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    problems: list[str] = []
+    try:
+        from vantage6_tpu.common.telemetry import KNOWN_METRICS
+        from vantage6_tpu.runtime.watchdog import RULE_CATALOG, default_slos
+    except Exception as e:  # pragma: no cover - environment broken
+        return [f"cannot import the fleet-fabric surface: {e!r}"]
+    fabric_files = (
+        os.path.join("vantage6_tpu", "common", "fleet.py"),
+        os.path.join("vantage6_tpu", "server", "fleet.py"),
+        os.path.join("vantage6_tpu", "server", "resources.py"),
+        os.path.join("vantage6_tpu", "runtime", "watchdog.py"),
+    )
+    sources: dict[str, str] = {}
+    for rel in fabric_files:
+        try:
+            sources[rel] = open(os.path.join(_REPO_ROOT, rel)).read()
+        except OSError as e:
+            return problems + [f"cannot read {rel}: {e}"]
+    declared = {
+        name for name, _kind, _help in KNOWN_METRICS
+        if name.startswith(("v6t_fleet_", "v6t_slo_"))
+    }
+    if not declared:
+        problems.append(
+            "no v6t_fleet_*/v6t_slo_* metrics declared in KNOWN_METRICS — "
+            "the fleet fabric is unobservable"
+        )
+    emitted: set[str] = set()
+    emitted_by: dict[str, set[str]] = {}
+    for rel, source in sources.items():
+        found = set(re.findall(r'"(v6t_(?:fleet|slo)_[a-z0-9_]+)"', source))
+        emitted |= found
+        for name in found:
+            emitted_by.setdefault(name, set()).add(rel)
+    for name in sorted(declared - emitted):
+        problems.append(
+            f"metric {name!r} declared in KNOWN_METRICS but never emitted "
+            "by the fleet fabric (common/fleet.py, server/fleet.py, "
+            "server/resources.py, runtime/watchdog.py)"
+        )
+    for name in sorted(emitted - declared):
+        rels = ", ".join(sorted(emitted_by[name]))
+        problems.append(
+            f"{rels} emits {name!r} which is not declared in "
+            "KNOWN_METRICS (common/telemetry.py)"
+        )
+    slos = default_slos()
+    if not slos:
+        problems.append(
+            "default_slos() is empty (runtime/watchdog.py) — the fabric "
+            "aggregates history nothing evaluates"
+        )
+    for slo in slos:
+        if slo.name not in RULE_CATALOG:
+            problems.append(
+                f"SLO {slo.name!r} compiles to a rule missing from "
+                "RULE_CATALOG — the default_rules() splice was dropped, "
+                "so its burn rate is never evaluated"
+            )
+    for endpoint in ("telemetry", "fleet"):
+        if endpoint not in _ROUTE_AUDIT:
+            problems.append(
+                f"the /api/{endpoint} route is missing from the "
+                "route-audit map (_ROUTE_AUDIT) — the endpoint/call-site "
+                "agreement check no longer covers the fleet fabric"
+            )
+    try:
+        doctor_src = open(
+            os.path.join(_REPO_ROOT, "tools", "doctor.py")
+        ).read()
+    except OSError as e:
+        return problems + [f"cannot read tools/doctor.py: {e}"]
+    if '"fleet"' not in doctor_src or "--live" not in doctor_src:
+        problems.append(
+            "tools/doctor.py no longer polls the fleet endpoint in --live "
+            "mode — the live fleet digest is gone"
+        )
     return problems
 
 
@@ -819,6 +925,17 @@ def main(argv: list[str]) -> int:
             sys.stderr.write(f"  {p}\n")
         return 1
 
+    fleet_problems = check_fleet_fabric()
+    if fleet_problems:
+        sys.stderr.write(
+            "FLEET FABRIC DRIFT: the declared v6t_fleet_*/v6t_slo_* "
+            "surface, the default SLO catalog, or the telemetry/fleet "
+            "route audit drifted (docs/observability.md 'fleet fabric'):\n"
+        )
+        for p in fleet_problems:
+            sys.stderr.write(f"  {p}\n")
+        return 1
+
     autopilot_problems = check_autopilot()
     if autopilot_problems:
         sys.stderr.write(
@@ -902,6 +1019,8 @@ def main(argv: list[str]) -> int:
               "declared <-> emitted, profile route audited")
         print("learning-plane audit ok: v6t_round_*/v6t_station_* declared "
               "<-> emitted, rules cataloged, rounds route audited")
+        print("fleet-fabric audit ok: v6t_fleet_*/v6t_slo_* declared <-> "
+              "emitted, SLOs cataloged, telemetry/fleet routes audited")
         print("fused-program audit ok: v6t_fused_* declared <-> emitted, "
               "docs/device_speed.md present and linked")
         print("storage-backend audit ok: sqlite3 contained to db.py, "

@@ -18,8 +18,16 @@ Output, per bundle set:
   "what happened around the failure" reads top to bottom without
   re-running anything under V6T_TRACE.
 
+Live mode (`--live URL`): instead of — or in addition to — bundles,
+poll a running server's `GET /api/fleet` + `GET /api/alerts` and render
+the SAME digest from the live fleet fabric: active alerts explained
+against the rule catalog (burning SLOs called out by objective), the
+per-source freshness table with the lagging source named, the merged
+census deltas, and recent cross-host events on the timeline.
+
 Usage:
     python tools/doctor.py bundle.jsonl [more.jsonl ...]
+        [--live URL]         poll a live server's fleet fabric
         [--trace TRACE_ID]   only records of this trace (prefix ok) +
                              untraced records in its time window
         [--window S]         untraced-record window around the trace
@@ -27,7 +35,7 @@ Usage:
         [--tail N]           last N timeline lines (default 200, 0 = all)
         [--json]             machine-readable digest instead of text
 
-Exit codes: 0 = rendered; 1 = no records found.
+Exit codes: 0 = rendered; 1 = no records found (or live poll failed).
 """
 from __future__ import annotations
 
@@ -77,6 +85,87 @@ def load(paths: list[str]) -> list[dict[str, Any]]:
         except OSError:
             pass
     return records
+
+
+def fetch_live(url: str) -> dict[str, Any] | None:
+    """Poll one server's fleet fabric (both endpoints are unauthenticated
+    aggregate views). None when the server is unreachable or pre-fleet —
+    the caller decides whether bundles alone are enough."""
+    from vantage6_tpu.common.rest import RestSession
+
+    session = RestSession(url)
+    try:
+        return {
+            "fleet": session.request("GET", "fleet"),
+            "alerts": session.request("GET", "alerts"),
+        }
+    except Exception as e:
+        print(f"cannot poll {url}: {e}", file=sys.stderr)
+        return None
+
+
+def live_records(live: dict[str, Any]) -> list[dict[str, Any]]:
+    """Map the live API payloads onto flight-bundle record shapes, so
+    alert_digest and the timeline render a live fleet exactly as they
+    render a dumped bundle."""
+    records: list[dict[str, Any]] = []
+    for a in (live.get("alerts") or {}).get("active") or []:
+        if isinstance(a, dict):
+            records.append({"type": "alert", "_file": "<live>", **a})
+    for e in (live.get("fleet") or {}).get("events") or []:
+        if isinstance(e, dict):
+            records.append({"type": "note", "_file": "<live>", **e})
+    return records
+
+
+def render_fleet(
+    fleet: dict[str, Any], alerts: list[dict[str, Any]]
+) -> list[str]:
+    """The live fleet digest: burning SLOs by name, the lagging source,
+    the per-source freshness table, and what the fleet is doing (top
+    counter deltas over the fast window)."""
+    lines = ["\nfleet digest:"]
+    srcs = [s for s in fleet.get("sources") or [] if isinstance(s, dict)]
+    stale = [s for s in srcs if s.get("stale")]
+    live = fleet.get("liveness") or {}
+    lines.append(
+        f"  {len(srcs)} source(s), {len(stale)} stale; daemons fresh "
+        f"{live.get('fresh_daemons', '?')}/{live.get('daemons', '?')}"
+        f" (ratio {live.get('ratio', '?')})"
+    )
+    burning = [
+        a for a in alerts if str(a.get("rule", "")).startswith("slo_")
+    ]
+    for a in burning:
+        lines.append(f"  BURNING SLO [{a.get('severity')}] "
+                     f"{a['rule']}: {a.get('message')}")
+    if not burning:
+        lines.append("  no SLO burning")
+    lagging = max(srcs, key=lambda s: s.get("age_s") or 0.0, default=None)
+    if lagging is not None and (stale or burning):
+        lines.append(
+            f"  lagging source: {lagging.get('source')} "
+            f"({lagging.get('age_s')}s since last push"
+            + (", STALE)" if lagging.get("stale") else ")")
+        )
+    if srcs:
+        lines.append(
+            "  source                      service      age_s    seq  series"
+        )
+        for s in sorted(srcs, key=lambda s: -(s.get("age_s") or 0.0)):
+            lines.append(
+                f"  {str(s.get('source', '?')):<27} "
+                f"{str(s.get('service', '')):<10} "
+                f"{s.get('age_s', 0):>8} {s.get('seq', 0):>6} "
+                f"{s.get('series', 0):>7}"
+                + ("  STALE" if s.get("stale") else "")
+            )
+    for d in fleet.get("top_deltas") or []:
+        lines.append(
+            f"  delta {d.get('name')}: +{d.get('delta'):g} "
+            f"over {d.get('window_s'):g}s"
+        )
+    return lines
 
 
 def _trace_of(rec: dict[str, Any]) -> str:
@@ -519,7 +608,12 @@ def render_line(rec: dict[str, Any]) -> str:
 
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("files", nargs="+", help="flight bundle(s) / span sink(s)")
+    ap.add_argument("files", nargs="*", help="flight bundle(s) / span sink(s)")
+    ap.add_argument(
+        "--live", metavar="URL",
+        help="poll a running server's /api/fleet + /api/alerts and fold "
+             "the live fleet fabric into the digest",
+    )
     ap.add_argument("--trace", help="restrict to one trace_id (prefix ok)")
     ap.add_argument(
         "--window", type=float, default=5.0,
@@ -532,9 +626,14 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable digest")
     args = ap.parse_args(argv)
+    if not args.files and not args.live:
+        ap.error("pass bundle file(s), --live URL, or both")
 
     records = load(args.files)
-    if not records:
+    live = fetch_live(args.live) if args.live else None
+    if live is not None:
+        records.extend(live_records(live))
+    if not records and live is None:
         print("no records found", file=sys.stderr)
         return 1
 
@@ -557,6 +656,7 @@ def main(argv: list[str]) -> int:
                 for h in headers
             ],
             "alerts": alerts,
+            "fleet": (live or {}).get("fleet"),
             "perf": perf,
             "learning": learning,
             "autopilot": autopilot,
@@ -587,6 +687,9 @@ def main(argv: list[str]) -> int:
                 print(f"      do:    {a['runbook']}")
     else:
         print("\nno alerts recorded")
+    if live is not None:
+        for line in render_fleet(live.get("fleet") or {}, alerts):
+            print(line)
     if perf:
         for line in render_perf(perf):
             print(line)

@@ -437,6 +437,13 @@ class UtilSubClient:
         recently resolved alerts and the rule catalog explaining each."""
         return self.parent.request("GET", "alerts")
 
+    def fleet(self) -> dict[str, Any]:
+        """The store-backed fleet view (GET /api/fleet): per-source
+        freshness, the merged counter/gauge census, top fast-window
+        deltas, recent fleet events and the daemon-liveness ratio —
+        the same view `tools/doctor.py --live` renders."""
+        return self.parent.request("GET", "fleet")
+
     def debug_dump(self) -> dict[str, Any]:
         """Trigger a server-side flight-recorder dump (POST
         /api/debug/dump); returns the bundle path + record census. Feed

@@ -92,6 +92,16 @@ class FlightRecorder:
             self._metrics.append({"ts": time.time(), "values": snap})
 
     # ------------------------------------------------------------- consumers
+    def recent_notes(
+        self, since: float = 0.0, limit: int = 256
+    ) -> list[dict[str, Any]]:
+        """Notes strictly newer than ``since`` (oldest-first, bounded) —
+        the fleet push path's delta read. The rings stay private; this is
+        the one sanctioned incremental reader beside dump()."""
+        with self._lock:
+            out = [r for r in self._notes if r.get("ts", 0.0) > since]
+        return out[-limit:]
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {

@@ -232,6 +232,38 @@ KNOWN_METRICS: list[tuple[str, str, str]] = [
      "device memory in use, summed over local devices"),
     ("v6t_device_mem_peak_bytes", "gauge",
      "worst-device peak bytes in use across local devices"),
+    # fleet telemetry fabric (common.fleet push path + server.fleet store
+    # — docs/observability.md "fleet fabric")
+    ("v6t_fleet_pushes_total", "counter",
+     "telemetry snapshots shipped to POST /api/telemetry"),
+    ("v6t_fleet_push_errors_total", "counter",
+     "fleet pushes that failed (server unreachable or rejected)"),
+    ("v6t_fleet_push_unsupported_total", "counter",
+     "fleet pushes pinned off against a pre-fleet server (404/405)"),
+    ("v6t_fleet_ingests_total", "counter",
+     "fleet snapshots accepted by POST /api/telemetry on this replica"),
+    ("v6t_fleet_ingest_rejects_total", "counter",
+     "telemetry push bodies rejected as undecodable"),
+    ("v6t_fleet_ingest_rows_total", "counter",
+     "metric sample rows appended to the fleet store by ingests"),
+    ("v6t_fleet_pruned_rows_total", "counter",
+     "fleet store rows deleted by the retention pruner"),
+    ("v6t_fleet_sources", "gauge",
+     "distinct telemetry sources in the fleet store's retention window"),
+    ("v6t_fleet_stale_sources", "gauge",
+     "fleet sources whose newest snapshot is past the staleness window"),
+    # the dispatch-latency SLO's series: observed server-side at the
+    # run start transition, and mirrored as per-event samples into the
+    # fleet store so burn rates survive replica restarts
+    ("v6t_run_dispatch_seconds", "histogram",
+     "assigned->started dispatch latency of runs (the dispatch SLO's "
+     "subject series)"),
+    # SLO engine (runtime.watchdog SloRule — docs/observability.md "SLO
+    # burn-rate alerting")
+    ("v6t_slo_evaluations_total", "counter",
+     "SLO burn-rate rule evaluations"),
+    ("v6t_slo_burning", "gauge",
+     "SLO rules currently alerting (burn over threshold in both windows)"),
 ]
 
 _KNOWN: dict[str, tuple[str, str]] = {

@@ -196,6 +196,7 @@ class NodeDaemon:
         poll_interval: float = 0.25,
         sync_interval: float = 15.0,
         ping_interval: float | None = None,
+        fleet_push_interval: float | None = None,
         name: str = "",
         max_concurrent_runs: int = 4,
         station_secret: str | bytes | None = None,
@@ -331,6 +332,20 @@ class NodeDaemon:
         self.collaboration_id: int = self.info["collaboration"]["id"]
         self.name = name or self.info["name"]
 
+        # fleet telemetry push (common/fleet.py): this daemon ships its
+        # compact snapshot + flight-note deltas through the same
+        # replica-rotating request path as everything else, on the sync
+        # worker's cadence. Capability-pinned inside the pusher: against
+        # a pre-fleet server the first 404 turns pushing into a no-op.
+        from vantage6_tpu.common.fleet import FleetPusher
+
+        self.fleet = FleetPusher(
+            source=f"daemon:{self.name}",
+            service="daemon",
+            request=self.request,
+            interval=fleet_push_interval,
+        )
+
         collab = self.request("GET", f"collaboration/{self.collaboration_id}")
         self.encrypted: bool = bool(collab.get("encrypted"))
 
@@ -391,6 +406,7 @@ class NodeDaemon:
             transport=cfg.get("transport", "batched"),
             event_wait=cfg.get("event_wait", 2.0),
             ping_interval=cfg.get("ping_interval"),
+            fleet_push_interval=cfg.get("fleet_push_interval"),
             **overrides,
         )
 
@@ -1169,15 +1185,22 @@ class NodeDaemon:
         daemon currently executes is in the claim set and skipped."""
         next_sweep = time.monotonic() + self.sync_interval
         next_ping = time.monotonic()  # first ping immediately
+        next_push = time.monotonic() + self.fleet.interval
         while True:
             now = time.monotonic()
-            # wake exactly at the next due event — pings and sweeps each
-            # keep their OWN cadence instead of quantizing to a shared
-            # tick (a shared tick silently stretched the 15 s sweep to 20)
-            wait = max(0.0, min(next_ping, next_sweep) - now)
+            # wake exactly at the next due event — pings, sweeps and
+            # fleet pushes each keep their OWN cadence instead of
+            # quantizing to a shared tick (a shared tick silently
+            # stretched the 15 s sweep to 20)
+            wait = max(0.0, min(next_ping, next_sweep, next_push) - now)
             if self._stop.wait(wait):
                 return
             now = time.monotonic()
+            if now >= next_push:
+                next_push = now + self.fleet.interval
+                # fail-soft by contract (counter + flight note inside);
+                # a pre-fleet server pins this into a no-op
+                self.fleet.maybe_push()
             if now >= next_ping:
                 next_ping = now + self.ping_interval
                 try:

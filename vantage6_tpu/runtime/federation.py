@@ -194,6 +194,11 @@ class Federation:
         # Federation is its own actuator — mask_station /
         # set_selection_weight / set_admission_limited below. close()
         # detaches the listener.
+        # ------------------------------------------------------ fleet push
+        # opt-in (attach_fleet_push): a Federation embedded next to a real
+        # control plane ships its snapshot at round boundaries, so the
+        # fleet view covers the aggregator process too — not just daemons
+        self.fleet = None
         self.autopilot = None
         ap_cfg = dict(config.autopilot or {})
         if ap_cfg.get("enabled"):
@@ -209,6 +214,35 @@ class Federation:
                 },
                 listener_key=f"autopilot-{key}",
             ).attach()
+
+    # ------------------------------------------------------------ fleet push
+    def attach_fleet_push(
+        self,
+        request: Callable[..., Any],
+        source: str | None = None,
+        interval: float | None = None,
+    ) -> Any:
+        """Arm fleet telemetry pushes for this Federation. ``request`` is
+        any REST callable with the ``request(method, endpoint,
+        json_body=...)`` shape (a bound ``RestSession.request``, a
+        daemon's replica-rotating ``request``). Pushes ride the round
+        boundaries (:meth:`wait_for_results`, :meth:`run_buffered`,
+        :meth:`run_fused_rounds`), rate-limited to the push interval —
+        an embedder that never calls this pays nothing."""
+        from vantage6_tpu.common.fleet import FleetPusher
+
+        self.fleet = FleetPusher(
+            source=source or f"federation:{self.config.name}",
+            service="federation",
+            request=request,
+            interval=interval,
+        )
+        return self.fleet
+
+    def _fleet_tick(self) -> None:
+        pusher = self.fleet
+        if pusher is not None:
+            pusher.maybe_push()  # fail-soft + capability-pinned inside
 
     # ------------------------------------------------------------------ data
     def load_all_data(self) -> None:
@@ -647,6 +681,7 @@ class Federation:
             )
         except Exception:  # pragma: no cover
             pass
+        self._fleet_tick()  # round boundary: ship the fleet snapshot
         return {
             "task": task,
             "selected": selected,
@@ -711,6 +746,7 @@ class Federation:
                 jax.block_until_ready(out[0])
         self._fused_dispatches += 1
         dt = time.monotonic() - t0
+        self._fleet_tick()  # dispatch boundary: ship the fleet snapshot
         return {
             "params": out[0],
             "opt_state": out[1],
@@ -813,6 +849,7 @@ class Federation:
                 f"{waiting} — bring them online or re-create the task "
                 "excluding them"
             )
+        self._fleet_tick()  # round boundary: ship the fleet snapshot
         return task.results()
 
     # -------------------------------------------------------------- dispatch

@@ -582,6 +582,299 @@ def _check_device_mem_growth(ctx: RuleContext) -> list[dict[str, Any]]:
     }]
 
 
+# --------------------------------------------------------------- SLO engine
+# Declarative service-level objectives over the STORE-BACKED fleet
+# history (server/fleet.py): the server's watchdog feed publishes each
+# objective's sample stream ("slo_dispatch", "slo_rounds") and the
+# per-source freshness census ("fleet_sources") read straight off the
+# shared fleet_metric table, so burn rates aggregate every daemon and
+# every replica — not one process's memory — and survive restarts.
+# Multi-window burn-rate alerting (SRE-workbook shape): an SLO alerts
+# only when the error budget is burning past threshold in BOTH the fast
+# window (catches an acute burn within one evaluation) and the slow
+# window (keeps sporadic noise quiet: a blip inflates the fast burn but
+# never the slow one). A process with no fleet feed (a daemon-side
+# watchdog) proposes nothing — the SLO rules are server-evaluated by
+# construction.
+
+
+@dataclasses.dataclass(frozen=True)
+class SloRule:
+    """One declarative objective: a human-readable goal, the fleet-feed
+    sample stream it reads, and the evaluation mode. Targets, windows
+    and burn thresholds live in ``Watchdog.config`` (``slo_*`` keys) so
+    operators — and tests — tune them live via ``configure()``.
+
+    Modes:
+
+    - ``threshold`` — event samples vs a latency/size target; the bad
+      fraction over each window, divided by ``slo_error_budget``, is
+      the burn rate.
+    - ``throughput`` — cumulative counter samples; the fast-window rate
+      must hold ``slo_throughput_floor_pct`` of the trailing
+      slow-window baseline rate.
+    - ``liveness`` — the per-source freshness census; the stale
+      fraction of daemon sources, divided by the liveness budget
+      (1 - ``slo_liveness_ratio``), is the burn rate.
+    """
+
+    name: str
+    objective: str
+    feed_key: str
+    mode: str
+    severity: str = "warning"
+    metrics: tuple[str, ...] = ()
+    runbook: str = ""
+
+    def to_alert_rule(self) -> AlertRule:
+        check = {
+            "threshold": _slo_threshold_check,
+            "throughput": _slo_throughput_check,
+            "liveness": _slo_liveness_check,
+        }[self.mode](self)
+        return AlertRule(
+            name=self.name,
+            severity=self.severity,
+            summary=(
+                f"SLO burn: {self.objective} — the error budget is "
+                "burning past threshold in both the fast and the slow "
+                "window (store-backed fleet history, not one process's "
+                "view)."
+            ),
+            runbook=self.runbook or (
+                "GET /api/fleet for per-source freshness and the counter "
+                "deltas; doctor --live names the burning SLO and the "
+                "lagging source — docs/observability.md 'SLO burn-rate "
+                "alerting'."
+            ),
+            metrics=self.metrics,
+            check=check,
+        )
+
+
+def _slo_samples(
+    ctx: RuleContext, key: str
+) -> list[tuple[float, float, str]]:
+    """(ts, value, source) samples from the fleet feed, deduplicated —
+    two in-process replicas both feed the same shared store, and a
+    double-counted sample would double the burn rate."""
+    seen: set[tuple[Any, float, float]] = set()
+    out: list[tuple[float, float, str]] = []
+    for s in ctx.feed_items(key):
+        ts, v = s.get("ts"), s.get("value")
+        if not isinstance(ts, (int, float)) or not isinstance(v, (int, float)):
+            continue
+        k = (s.get("source"), round(float(ts), 6), float(v))
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append((float(ts), float(v), str(s.get("source") or "?")))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _slo_windows(ctx: RuleContext) -> tuple[float, float]:
+    return (
+        float(ctx.config["slo_fast_window_s"]),
+        float(ctx.config["slo_slow_window_s"]),
+    )
+
+
+def _slo_threshold_check(slo: SloRule):
+    def check(ctx: RuleContext) -> list[dict[str, Any]]:
+        REGISTRY.counter("v6t_slo_evaluations_total").inc()
+        samples = _slo_samples(ctx, slo.feed_key)
+        if not samples:
+            return []
+        target = float(ctx.config["slo_dispatch_target_s"])
+        budget = max(1e-9, float(ctx.config["slo_error_budget"]))
+        thr = float(ctx.config["slo_burn_threshold"])
+        min_n = int(ctx.config["slo_min_samples"])
+        fast, slow = _slo_windows(ctx)
+
+        def burn(window: float) -> tuple[float | None, int]:
+            w = [v for ts, v, _ in samples if ctx.now - ts <= window]
+            if len(w) < min_n:
+                return None, len(w)
+            return (sum(1 for v in w if v > target) / len(w)) / budget, len(w)
+
+        burn_fast, n_fast = burn(fast)
+        burn_slow, _ = burn(slow)
+        if (
+            burn_fast is None or burn_slow is None
+            or burn_fast < thr or burn_slow < thr
+        ):
+            return []
+        # name the worst offender: most over-target samples in the fast
+        # window — "the lagging source" doctor --live calls out
+        by_src: dict[str, int] = {}
+        for ts, v, src in samples:
+            if ctx.now - ts <= fast and v > target:
+                by_src[src] = by_src.get(src, 0) + 1
+        worst = max(by_src, key=by_src.get) if by_src else None
+        return [{
+            "message": (
+                f"SLO '{slo.objective}' (target {target:g}s): error "
+                f"budget burning at {burn_fast:.1f}x over the fast "
+                f"{fast:g}s window ({n_fast} samples) and "
+                f"{burn_slow:.1f}x over the slow {slow:g}s window "
+                f"(threshold {thr:g}x)"
+                + (f"; worst source {worst} "
+                   f"({by_src[worst]} over-target)" if worst else "")
+            ),
+            "labels": {"slo": slo.name},
+        }]
+
+    return check
+
+
+def _slo_throughput_check(slo: SloRule):
+    def check(ctx: RuleContext) -> list[dict[str, Any]]:
+        REGISTRY.counter("v6t_slo_evaluations_total").inc()
+        samples = _slo_samples(ctx, slo.feed_key)
+        if not samples:
+            return []
+        floor_pct = float(ctx.config["slo_throughput_floor_pct"])
+        min_n = int(ctx.config["slo_min_samples"])
+        fast, slow = _slo_windows(ctx)
+
+        def rate(window: float) -> tuple[float, int]:
+            # counters are per-source cumulative: delta per source, then
+            # sum — one source restarting must not read as negative fleet
+            # throughput
+            first: dict[str, float] = {}
+            last: dict[str, float] = {}
+            n = 0
+            for ts, v, src in samples:
+                if ctx.now - ts > window:
+                    continue
+                n += 1
+                first.setdefault(src, v)
+                last[src] = v
+            total = sum(
+                max(0.0, last[s] - first[s]) for s in last
+            )
+            return total / max(window, 1e-9), n
+
+        slow_rate, n_slow = rate(slow)
+        fast_rate, n_fast = rate(fast)
+        if n_slow < min_n or n_fast < 2 or slow_rate <= 0:
+            return []  # no established baseline -> nothing to burn
+        floor_rate = (floor_pct / 100.0) * slow_rate
+        if fast_rate >= floor_rate:
+            return []
+        return [{
+            "message": (
+                f"SLO '{slo.objective}': round throughput "
+                f"{fast_rate:.4g}/s over the fast {fast:g}s window is "
+                f"below {floor_pct:g}% of the trailing {slow:g}s-window "
+                f"baseline ({slow_rate:.4g}/s)"
+            ),
+            "labels": {"slo": slo.name},
+        }]
+
+    return check
+
+
+def _slo_liveness_check(slo: SloRule):
+    def check(ctx: RuleContext) -> list[dict[str, Any]]:
+        REGISTRY.counter("v6t_slo_evaluations_total").inc()
+        daemons: dict[str, dict[str, Any]] = {}
+        for s in ctx.feed_items(slo.feed_key):
+            name = s.get("source")
+            if name and str(s.get("service") or "").startswith("daemon"):
+                daemons[str(name)] = s
+        if not daemons:
+            return []
+        budget = max(1e-9, 1.0 - float(ctx.config["slo_liveness_ratio"]))
+        thr = float(ctx.config["slo_burn_threshold"])
+        grace = float(ctx.config["slo_liveness_slow_grace_s"])
+        fast, slow = _slo_windows(ctx)
+        ages = {
+            src: float(s.get("age_s") or 0.0) for src, s in daemons.items()
+        }
+        # fast window: the freshness census's own stale verdict; slow
+        # window: stale PAST the grace — a daemon mid-restart inflates
+        # the fast burn only, and the AND keeps the alert quiet
+        stale_fast = [s for s, d in daemons.items() if d.get("stale")]
+        stale_slow = [s for s in stale_fast if ages[s] > grace]
+        burn_fast = (len(stale_fast) / len(daemons)) / budget
+        burn_slow = (len(stale_slow) / len(daemons)) / budget
+        if burn_fast < thr or burn_slow < thr:
+            return []
+        worst = max(ages, key=ages.get)
+        return [{
+            "message": (
+                f"SLO '{slo.objective}': {len(stale_fast)} of "
+                f"{len(daemons)} daemon sources are stale (burn "
+                f"{burn_fast:.1f}x fast {fast:g}s window / "
+                f"{burn_slow:.1f}x slow {slow:g}s window, threshold "
+                f"{thr:g}x); most lagging: {worst} "
+                f"({ages[worst]:.1f}s since last push)"
+            ),
+            "labels": {"slo": slo.name},
+        }]
+
+    return check
+
+
+def default_slos() -> list[SloRule]:
+    return [
+        SloRule(
+            name="slo_dispatch_latency",
+            objective=(
+                "99% of run dispatches start within the target latency"
+            ),
+            feed_key="slo_dispatch",
+            mode="threshold",
+            severity="critical",
+            metrics=("v6t_run_dispatch_seconds",),
+            runbook=(
+                "GET /api/fleet: check per-source freshness (a lagging "
+                "daemon claims late) and v6t_rest_* deltas (a slow "
+                "transport dispatches late); doctor --live names the "
+                "worst source. Tune slo_dispatch_target_s / "
+                "slo_error_budget via Watchdog.configure."
+            ),
+        ),
+        SloRule(
+            name="slo_round_throughput",
+            objective=(
+                "round throughput holds the floor fraction of its "
+                "trailing baseline"
+            ),
+            feed_key="slo_rounds",
+            mode="throughput",
+            severity="warning",
+            metrics=("v6t_round_updates_total",),
+            runbook=(
+                "compare straggler_station / queue_buildup alerts and "
+                "/api/fleet top_deltas: a collapsed round rate with busy "
+                "REST counters is a wedged aggregation, with quiet "
+                "counters a stalled submitter. Floor: "
+                "slo_throughput_floor_pct of the slow-window rate."
+            ),
+        ),
+        SloRule(
+            name="slo_daemon_liveness",
+            objective=(
+                "the fleet's daemon sources keep pushing fresh telemetry"
+            ),
+            feed_key="fleet_sources",
+            mode="liveness",
+            severity="warning",
+            metrics=(),
+            runbook=(
+                "GET /api/fleet liveness block for who went quiet; a "
+                "single daemon also raises daemon_lapsed (per-node, "
+                "critical) — this SLO is the aggregate budget. Restart "
+                "the lagging daemons; pushes resume on their next sync "
+                "tick."
+            ),
+        ),
+    ]
+
+
 def default_rules() -> list[AlertRule]:
     return [
         AlertRule(
@@ -814,7 +1107,7 @@ def default_rules() -> list[AlertRule]:
             metrics=("v6t_device_mem_bytes_in_use",),
             check=_check_device_mem_growth,
         ),
-    ]
+    ] + [slo.to_alert_rule() for slo in default_slos()]
 
 
 DEFAULT_RULES = default_rules()
@@ -899,6 +1192,16 @@ class Watchdog:
             "recompile_storm_window": 4,
             "device_mem_growth_evals": 4,
             "device_mem_growth_pct": 10.0,
+            # SLO engine (store-backed fleet history; see default_slos)
+            "slo_dispatch_target_s": 2.0,
+            "slo_error_budget": 0.01,
+            "slo_burn_threshold": 6.0,
+            "slo_fast_window_s": 300.0,
+            "slo_slow_window_s": 3600.0,
+            "slo_min_samples": 4,
+            "slo_throughput_floor_pct": 50.0,
+            "slo_liveness_ratio": 0.9,
+            "slo_liveness_slow_grace_s": 120.0,
         }
         self._history_len = max(8, history)
         self._feeds: dict[str, Callable[[], Any]] = {}  # guarded-by: _lock
@@ -1097,6 +1400,10 @@ class Watchdog:
                 self._recent.append(alert)
                 cleared.append(alert)
             n_active = len(self._active)
+            n_slo = sum(
+                1 for a in self._active.values()
+                if a.rule.startswith("slo_")
+            )
             active = [a.to_dict() for a in self._active.values()]
             self.last_eval_at = now
 
@@ -1113,6 +1420,7 @@ class Watchdog:
         if cleared:
             REGISTRY.counter("v6t_alerts_cleared_total").inc(len(cleared))
         REGISTRY.gauge("v6t_alerts_active").set(n_active)
+        REGISTRY.gauge("v6t_slo_burning").set(n_slo)
         REGISTRY.gauge("v6t_watchdog_last_eval_unixtime").set(now)
         # fold the verdict into telemetry + the flight recorder's metric
         # history every pass — a dump carries the health trajectory

@@ -123,6 +123,13 @@ class ServerApp:
         # selection/mask/admission policies self-suppress here. Listener
         # key is per-replica: two replicas may both attach, and the
         # store-level CAS keeps their concurrent remediation exactly-once.
+        # fleet self-ingest cadence: the server is itself a fleet source —
+        # its snapshot lands in the fleet tables on the watchdog tick,
+        # rate-limited to the push interval like any remote pusher
+        # replica-local: watchdog-thread-only cursor state
+        self._fleet_last_push = 0.0
+        self._fleet_notes_since = time.time()
+        self._fleet_seq = 0
         self.autopilot = None
         if os.environ.get("V6T_AUTOPILOT", "").strip().lower() in (
             "1", "true", "yes", "on",
@@ -221,6 +228,41 @@ class ServerApp:
                 feed["replicas"] = pubsub.list_replicas(self.db)
             except Exception:  # heartbeat must never break the rule feeds
                 pass
+        # fleet fabric (server/fleet.py): self-ingest this replica's own
+        # compact snapshot on the push cadence — the server is a fleet
+        # source like any daemon — then publish the store-backed series
+        # and freshness census the SLO rules read. The tick piggybacks
+        # the watchdog thread exactly as remote pushers piggyback their
+        # ping workers.
+        from vantage6_tpu.common import fleet as fleet_push
+        from vantage6_tpu.server import fleet
+
+        now = time.time()
+        if now - self._fleet_last_push >= fleet_push.push_interval():
+            self._fleet_last_push = now
+            try:
+                payload = fleet_push.build_snapshot(
+                    self.replica_id, "server", self._fleet_seq,
+                    notes_since=self._fleet_notes_since,
+                )
+                fleet.ingest(self.db, payload)
+                self._fleet_seq += 1
+                for note in payload.get("notes") or []:
+                    ts = note.get("ts")
+                    if isinstance(ts, (int, float)):
+                        self._fleet_notes_since = max(
+                            self._fleet_notes_since, float(ts)
+                        )
+            except Exception:  # self-ingest must never break the rule feeds
+                pass
+        slow = float(self.watchdog.config.get("slo_slow_window_s", 3600.0))
+        feed["fleet_sources"] = fleet.sources(self.db, now)
+        feed["slo_dispatch"] = fleet.metric_series(
+            self.db, "v6t_run_dispatch_seconds", now - slow
+        )
+        feed["slo_rounds"] = fleet.metric_series(
+            self.db, "v6t_round_updates_total", now - slow
+        )
         return feed
 
     def _hub_check(self) -> tuple[bool, str]:

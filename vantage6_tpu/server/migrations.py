@@ -152,6 +152,40 @@ def _m7_replica_tables(db: Database) -> None:
     )
 
 
+def _m8_fleet_tables(db: Database) -> None:
+    """v8: fleet telemetry fabric. Two append-only tables behind
+    `POST /api/telemetry` / `GET /api/fleet` (server/fleet.py):
+    `fleet_metric` — timestamped metric samples, one row per (source,
+    series) per pushed snapshot, CAS-free appends pruned by the
+    retention floor; `fleet_event` — flight-note/alert deltas riding
+    the same pushes. Both are keyed for the two hot reads: the census
+    ("latest row per source+series") and the SLO engine's windowed
+    series scan ("all samples of series X since T")."""
+    db.execute(
+        "CREATE TABLE IF NOT EXISTS fleet_metric ("
+        "id INTEGER PRIMARY KEY AUTOINCREMENT, "
+        "source TEXT NOT NULL, service TEXT, seq INTEGER, "
+        "name TEXT NOT NULL, kind TEXT, value REAL, ts REAL NOT NULL)"
+    )
+    db.execute(
+        "CREATE INDEX IF NOT EXISTS idx_fleet_metric_name_ts "
+        "ON fleet_metric(name, ts)"
+    )
+    db.execute(
+        "CREATE INDEX IF NOT EXISTS idx_fleet_metric_source "
+        "ON fleet_metric(source, name, id)"
+    )
+    db.execute(
+        "CREATE TABLE IF NOT EXISTS fleet_event ("
+        "id INTEGER PRIMARY KEY AUTOINCREMENT, "
+        "source TEXT NOT NULL, service TEXT, kind TEXT NOT NULL, "
+        "ts REAL NOT NULL, data TEXT)"
+    )
+    db.execute(
+        "CREATE INDEX IF NOT EXISTS idx_fleet_event_ts ON fleet_event(ts)"
+    )
+
+
 # replica-local: code-derived constant, identical on every replica
 MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
     (1, "baseline schema", _m1_baseline),
@@ -164,6 +198,8 @@ MIGRATIONS: list[tuple[int, str, Callable[[Database], None]]] = [
     (6, "tracing metadata index: task(trace_id)", _m6_trace_metadata),
     (7, "replica tables: pubsub event stream, heartbeats, learning rounds",
      _m7_replica_tables),
+    (8, "fleet telemetry tables: cross-host metric samples + event deltas",
+     _m8_fleet_tables),
 ]
 
 SCHEMA_VERSION = MIGRATIONS[-1][0]

@@ -263,6 +263,11 @@ def register_resources(srv: "ServerApp") -> None:
             from vantage6_tpu.server import pubsub
 
             out["replicas"] = pubsub.list_replicas(srv.db)
+        # fleet telemetry census: how many sources push here, how many
+        # went quiet (full view at /api/fleet)
+        from vantage6_tpu.server import fleet
+
+        out["fleet"] = {**fleet.health_block(srv.db), "url": "/api/fleet"}
         return out
 
     @app.route("/api/alerts")
@@ -282,6 +287,45 @@ def register_resources(srv: "ServerApp") -> None:
             ),
             "rules": RULE_CATALOG,
         }
+
+    @app.route("/api/telemetry", methods=("POST",))
+    def telemetry_push(req: Request):
+        """Fleet push ingest: daemons and Federation processes POST their
+        compact telemetry snapshot + flight-note deltas here (wire-v2
+        blob, base64 in a JSON envelope — see `common.fleet.encode_push`).
+        Samples land as CAS-free appends in the fleet tables, so pushing
+        through ANY replica of a shared store feeds the same fleet view.
+        Any authenticated principal may push: nodes push their daemon's
+        snapshot, users push a Federation's — the payload carries
+        aggregate counters and ops notes, never secrets."""
+        _identity(srv, req)
+        from vantage6_tpu.common.fleet import decode_push
+        from vantage6_tpu.server import fleet
+
+        body = req.json
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be a JSON object")
+        try:
+            payload = decode_push(body)
+        except ValueError as e:
+            from vantage6_tpu.common.telemetry import REGISTRY
+
+            REGISTRY.counter("v6t_fleet_ingest_rejects_total").inc()
+            raise HTTPError(400, f"undecodable telemetry push: {e}") from None
+        return {"accepted": True, **fleet.ingest(srv.db, payload)}, 201
+
+    @app.route("/api/fleet")
+    def fleet_index(req: Request):
+        """The aggregated fleet view: per-source freshness, the merged
+        counter/gauge census, top-k counter deltas over the SLO fast
+        window, recent cross-host events, and the daemon-liveness ratio.
+        Read from the shared store, so every replica serves the SAME
+        answer. Unauthenticated like /api/health and /api/metrics — it
+        carries aggregate operational state only, never payloads or
+        principals."""
+        from vantage6_tpu.server import fleet
+
+        return fleet.fleet_view(srv.db)
 
     @app.route("/api/rounds")
     def rounds_index(req: Request):
@@ -1937,6 +1981,30 @@ def _create_task(srv: "ServerApp", req: Request) -> tuple[dict[str, Any], int]:
 # ------------------------------------------------------------------- helpers
 
 
+def _observe_dispatch(srv: "ServerApp", run: m.TaskRun) -> None:
+    """Assigned->started dispatch latency of one run, observed at the
+    activation CAS: into the process histogram (scrape-grade) AND as a
+    per-event fleet sample (store-backed — the dispatch-latency SLO's
+    burn windows read these rows, from whichever replica served the
+    activation). Telemetry must never fail a dispatch."""
+    try:
+        assigned = float(run.assigned_at or 0.0)
+        if assigned <= 0.0:
+            return
+        started = float(run.started_at or time.time())
+        lat = max(0.0, started - assigned)
+        from vantage6_tpu.common.telemetry import REGISTRY
+        from vantage6_tpu.server import fleet
+
+        REGISTRY.histogram("v6t_run_dispatch_seconds").observe(lat)
+        fleet.record_sample(
+            srv.db, srv.replica_id, "server",
+            "v6t_run_dispatch_seconds", lat,
+        )
+    except Exception:
+        pass
+
+
 def _apply_run_patch(
     srv: "ServerApp",
     node: m.Node,
@@ -1991,6 +2059,10 @@ def _apply_run_patch(
             ):
                 for k, v in sets.items():
                     setattr(run, k, v)
+                if new_status == TaskStatus.ACTIVE.value:
+                    # the activation CAS winner IS the dispatch: record
+                    # assigned->started latency, the dispatch SLO's series
+                    _observe_dispatch(srv, run)
                 break
             # lost the race: re-read and re-decide against the NEW state
             reread = m.TaskRun.get(run.id)
